@@ -1,0 +1,20 @@
+"""can_tpu — a TPU-native (JAX/XLA/Pallas) crowd-counting training framework.
+
+A ground-up re-design of the capabilities of the reference repo
+``zgzhengSEU/CAN-distributed-pytorch`` (CANNet multi-GPU DDP training,
+see /root/reference) for TPU hardware:
+
+* NHWC layouts, static shapes, bf16-capable compute (MXU-friendly).
+* Adaptive pooling / align-corners bilinear resize expressed as small
+  matmuls instead of gathers (reference: model/CANNet.py:42-81).
+* Data parallelism via ``jax.sharding`` + ``jit`` with XLA collectives
+  over ICI instead of NCCL DDP (reference: train.py:121-122,
+  utils/distributed_utils.py:23-27).
+* Spatial (context) parallelism for very-high-resolution images via
+  ``shard_map`` + halo exchange with ``lax.ppermute`` — the CNN analogue
+  of ring attention (the reference handles high-res only via batch=1).
+* Bucketed, masked batching for variable-resolution images
+  (reference: batch_size=1 + fully dynamic shapes, train.py:177).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+from .cannet import (
+    FRONTEND_CFG,
+    BACKEND_CFG,
+    CONTEXT_SCALES,
+    LocalOps,
+    cannet_apply,
+    cannet_init,
+    load_vgg16_frontend,
+    param_count,
+)
+
+__all__ = [
+    "FRONTEND_CFG",
+    "BACKEND_CFG",
+    "CONTEXT_SCALES",
+    "LocalOps",
+    "cannet_apply",
+    "cannet_init",
+    "load_vgg16_frontend",
+    "param_count",
+]

@@ -1,0 +1,186 @@
+"""CANNet (CVPR'19 Context-Aware Crowd Counting) as a pure-functional JAX model.
+
+Re-design of the reference torch module (reference: model/CANNet.py:8-121):
+
+* VGG-16 frontend: convs [64,64,M,128,128,M,256,256,256,M,512,512,512]
+  (model/CANNet.py:11-12) — 10 conv+ReLU layers, 3 maxpools → 1/8 res.
+* Context block: for S in {1,2,3,6}: adaptive-avg-pool to SxS → biasless 1x1
+  conv → align-corners bilinear upsample to feature size → contrast c = s - fv
+  → biasless 1x1 conv → sigmoid weight (model/CANNet.py:39-84); fused
+  fi = sum(w_i * s_i) / (sum(w_i) + 1e-12); concat(fv, fi) → 1024ch.
+* Backend: 6 dilated(rate-2) 3x3 convs [512,512,512,256,128,64]
+  (model/CANNet.py:13,15-16) + 1x1 output conv → 1-channel density map at 1/8
+  input resolution.
+
+TPU-first choices (NOT a torch translation):
+
+* Pure params-pytree + apply function (no Module state) — composes directly
+  with jit/grad/shard_map and lets us swap the spatial primitives.
+* NHWC activations / HWIO kernels (channels ride the 128-wide TPU lanes).
+* Adaptive pool and align-corners upsample are matmuls against tiny static
+  matrices (see ops/pooling.py, ops/resize.py) — no gathers, fully fusable.
+* ``ops`` injection: the distributed spatial-parallel forward
+  (parallel/spatial.py) reuses this exact function body with halo-exchange
+  convolutions and psum-based global pooling.
+* Optional bf16 compute with f32 params/accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from can_tpu.ops.conv import conv1x1, conv2d
+from can_tpu.ops.pooling import adaptive_avg_pool2d, max_pool2d
+from can_tpu.ops.resize import resize_bilinear_align_corners
+
+# Layer configs (reference: model/CANNet.py:11-13).
+FRONTEND_CFG: Sequence = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512)
+BACKEND_CFG: Sequence[int] = (512, 512, 512, 256, 128, 64)
+CONTEXT_SCALES: Sequence[int] = (1, 2, 3, 6)
+_FEAT_CH = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalOps:
+    """Spatial primitives used by the forward pass.
+
+    The default single-device implementations; parallel/spatial.py provides a
+    drop-in replacement whose convs halo-exchange over an ``sp`` mesh axis and
+    whose pooling psums across shards.
+    """
+
+    conv2d: Callable = conv2d
+    max_pool: Callable = max_pool2d
+    adaptive_pool: Callable = adaptive_avg_pool2d
+    upsample: Callable = resize_bilinear_align_corners
+    # Full (unsharded) feature H, W; None means "use local shape".
+    global_hw: Any = None
+
+
+def cannet_init(key: jax.Array, dtype=jnp.float32) -> dict:
+    """Initialise params: conv weights ~ N(0, 0.01), biases 0
+    (reference: model/CANNet.py:93-101).  Same key => identical params on
+    every host — replaces the reference's rank0-save/barrier/load protocol
+    (train.py:104-114) by construction.
+    """
+
+    def conv_p(key, kh, kw, cin, cout, bias=True):
+        w = jax.random.normal(key, (kh, kw, cin, cout), dtype) * 0.01
+        p = {"w": w}
+        if bias:
+            p["b"] = jnp.zeros((cout,), dtype)
+        return p
+
+    keys = iter(jax.random.split(key, 64))
+    params: dict = {"frontend": [], "context": {}, "backend": [], "output": None}
+    cin = 3
+    for v in FRONTEND_CFG:
+        if v == "M":
+            continue
+        params["frontend"].append(conv_p(next(keys), 3, 3, cin, v))
+        cin = v
+    for s in CONTEXT_SCALES:
+        params["context"][f"s{s}"] = {
+            # biasless 1x1 convs (reference: model/CANNet.py:18-25): stored as
+            # (Cin, Cout) matrices — a 1x1 conv IS a channel matmul.
+            "ave": jax.random.normal(next(keys), (_FEAT_CH, _FEAT_CH), dtype) * 0.01,
+            "weight": jax.random.normal(next(keys), (_FEAT_CH, _FEAT_CH), dtype) * 0.01,
+        }
+    cin = 2 * _FEAT_CH
+    for v in BACKEND_CFG:
+        params["backend"].append(conv_p(next(keys), 3, 3, cin, v))
+        cin = v
+    params["output"] = conv_p(next(keys), 1, 1, BACKEND_CFG[-1], 1)
+    return params
+
+
+def cannet_apply(
+    params: Mapping,
+    x: jax.Array,
+    *,
+    ops: LocalOps = LocalOps(),
+    compute_dtype=None,
+    precision=None,
+) -> jax.Array:
+    """Forward pass: NHWC image batch -> (N, H/8, W/8, 1) density map.
+
+    Mirrors reference model/CANNet.py:39-91 semantically; structured around
+    injected spatial primitives so the same body runs single-device or
+    H-sharded (context-parallel) under shard_map.
+    """
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+
+    # --- VGG-16 frontend ---
+    i = 0
+    for v in FRONTEND_CFG:
+        if v == "M":
+            x = ops.max_pool(x)
+        else:
+            p = params["frontend"][i]
+            x = conv_relu(x, p, ops, dilation=1, precision=precision)
+            i += 1
+    fv = x
+
+    # --- multi-scale context block ---
+    hw = ops.global_hw or (fv.shape[-3], fv.shape[-2])
+    num = 0.0
+    den = 0.0
+    for s in CONTEXT_SCALES:
+        cp = params["context"][f"s{s}"]
+        ave = ops.adaptive_pool(fv, s)
+        ave = conv1x1(ave, cp["ave"].astype(ave.dtype), precision=precision)
+        sm = ops.upsample(ave, hw)
+        contrast = sm - fv
+        w = jax.nn.sigmoid(
+            conv1x1(contrast, cp["weight"].astype(fv.dtype), precision=precision)
+        )
+        num = num + w * sm
+        den = den + w
+    fi = num / (den + 1e-12)
+    x = jnp.concatenate([fv, fi], axis=-1)
+
+    # --- dilated backend ---
+    for p in params["backend"]:
+        x = conv_relu(x, p, ops, dilation=2, precision=precision)
+    p = params["output"]
+    x = ops.conv2d(
+        x, p["w"].astype(x.dtype), p["b"].astype(x.dtype), padding=0, precision=precision
+    )
+    return x
+
+
+def conv_relu(x, p, ops: LocalOps, *, dilation: int, precision=None):
+    w = p["w"].astype(x.dtype)
+    b = p["b"].astype(x.dtype)
+    return jax.nn.relu(ops.conv2d(x, w, b, dilation=dilation, precision=precision))
+
+
+def load_vgg16_frontend(params: dict, npz_path: str) -> dict:
+    """Copy pretrained VGG-16 conv weights into the frontend.
+
+    The reference downloads torchvision's VGG-16 and copies the first 20
+    tensors by ordinal position (model/CANNet.py:26-35).  With zero egress we
+    instead load a local ``.npz`` produced by tools/convert_vgg16.py (keys
+    ``conv{i}_w`` (HWIO) / ``conv{i}_b`` for i in 0..9).
+    """
+    data = np.load(npz_path)
+    out = dict(params)
+    frontend = []
+    for i, p in enumerate(params["frontend"]):
+        w = jnp.asarray(data[f"conv{i}_w"], dtype=p["w"].dtype)
+        b = jnp.asarray(data[f"conv{i}_b"], dtype=p["b"].dtype)
+        if w.shape != p["w"].shape:
+            raise ValueError(f"conv{i}: npz shape {w.shape} != expected {p['w'].shape}")
+        frontend.append({"w": w, "b": b})
+    out["frontend"] = frontend
+    return out
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -1,0 +1,13 @@
+from .pooling import adaptive_avg_pool2d, adaptive_pool_matrix, max_pool2d
+from .resize import resize_bilinear_align_corners, upsample_matrix
+from .conv import conv2d, conv1x1
+
+__all__ = [
+    "adaptive_avg_pool2d",
+    "adaptive_pool_matrix",
+    "max_pool2d",
+    "resize_bilinear_align_corners",
+    "upsample_matrix",
+    "conv2d",
+    "conv1x1",
+]

@@ -1,0 +1,49 @@
+"""Convolution wrappers for NHWC / HWIO layouts (TPU-native).
+
+The reference model uses torch Conv2d in NCHW/OIHW (model/CANNet.py:104-121);
+on TPU the canonical layout is NHWC activations with HWIO kernels so the
+channel dim rides the 128-wide lanes and matmuls hit the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(x, w, b=None, *, dilation: int = 1, padding=None, precision=None):
+    """3x3 (or any) conv, SAME-style padding = dilation by default.
+
+    x: (N, H, W, Cin);  w: (kh, kw, Cin, Cout);  b: (Cout,) or None.
+    ``padding=dilation`` with kernel 3 keeps spatial size, matching the
+    reference's ``nn.Conv2d(k=3, padding=d, dilation=d)`` (model/CANNet.py:114).
+    """
+    if padding is None:
+        ph = dilation * (w.shape[0] // 2)
+        pw = dilation * (w.shape[1] // 2)
+        pad = ((ph, ph), (pw, pw))
+    else:
+        pad = ((padding, padding), (padding, padding))
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding=pad,
+        rhs_dilation=(dilation, dilation),
+        dimension_numbers=_DIMS,
+        precision=precision,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if b is not None:
+        out = out + b
+    return out.astype(x.dtype)
+
+
+def conv1x1(x, w, b=None, *, precision=None):
+    """1x1 conv == channel matmul. w: (Cin, Cout)."""
+    out = jnp.einsum("...c,cd->...d", x, w, precision=precision)
+    if b is not None:
+        out = out + b
+    return out.astype(x.dtype)

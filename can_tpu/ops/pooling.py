@@ -1,0 +1,67 @@
+"""Pooling ops with PyTorch-exact semantics, expressed TPU-first.
+
+Adaptive average pooling is a *linear* map along each spatial axis once the
+(static) input size is known, so instead of gathers / dynamic windows we
+materialise a tiny ``(out_size, in_size)`` averaging matrix at trace time and
+contract with it — two small matmuls that XLA places on the MXU and fuses
+freely.  Bin boundaries replicate ``torch.nn.functional.adaptive_avg_pool2d``
+(reference use: model/CANNet.py:42,51,60,70): for output index ``i``,
+``start = floor(i * in / out)``, ``end = ceil((i + 1) * in / out)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.lru_cache(maxsize=None)
+def _adaptive_pool_matrix_np(in_size: int, out_size: int) -> np.ndarray:
+    m = np.zeros((out_size, in_size), dtype=np.float32)
+    for i in range(out_size):
+        start = (i * in_size) // out_size
+        end = -((-(i + 1) * in_size) // out_size)  # ceil((i+1)*in/out)
+        m[i, start:end] = 1.0 / (end - start)
+    return m
+
+
+def adaptive_pool_matrix(in_size: int, out_size: int, dtype=jnp.float32):
+    """(out_size, in_size) row-stochastic averaging matrix (PyTorch bins)."""
+    return jnp.asarray(_adaptive_pool_matrix_np(in_size, out_size), dtype=dtype)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    """PyTorch-exact adaptive average pool for NHWC tensors.
+
+    x: (..., H, W, C);  output_size: int or (Sh, Sw).
+    Returns (..., Sh, Sw, C).
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    sh, sw = output_size
+    h, w = x.shape[-3], x.shape[-2]
+    ph = adaptive_pool_matrix(h, sh, x.dtype)
+    pw = adaptive_pool_matrix(w, sw, x.dtype)
+    # HIGHEST: these contractions are tiny (S <= 6 output bins) but parity
+    # critical — default matmul precision costs ~1e-3 relative error.
+    return jnp.einsum(
+        "...hwc,ph,qw->...pqc", x, ph, pw, precision=jax.lax.Precision.HIGHEST
+    )
+
+
+def max_pool2d(x, window: int = 2, stride: int = 2):
+    """Max pool over NHWC, VALID padding (floor division of odd sizes —
+    matches torch.nn.MaxPool2d(kernel_size=2, stride=2), reference
+    model/CANNet.py:112)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )

@@ -1,0 +1,57 @@
+"""Bilinear resize with ``align_corners=True`` semantics, expressed TPU-first.
+
+``jax.image.resize`` uses half-pixel centers, which does NOT match
+``torch.nn.functional.interpolate(mode='bilinear', align_corners=True)``
+(reference use: model/CANNet.py:45-46,54-55,63-64,75-76).  Like adaptive
+pooling, align-corners bilinear interpolation is a separable linear map with
+static coefficients, so we build tiny ``(out, in)`` interpolation matrices at
+trace time and contract — matmuls instead of gathers.  For the CANNet context
+block the inputs are S x S grids with S in {1, 2, 3, 6}, so the contraction is
+effectively a broadcast-multiply-accumulate the compiler fuses into the
+surrounding elementwise work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _upsample_matrix_np(in_size: int, out_size: int) -> np.ndarray:
+    m = np.zeros((out_size, in_size), dtype=np.float32)
+    if in_size == 1:
+        m[:, 0] = 1.0
+        return m
+    if out_size == 1:
+        # align_corners with a single output sample reads source index 0.
+        m[0, 0] = 1.0
+        return m
+    scale = (in_size - 1) / (out_size - 1)
+    for i in range(out_size):
+        pos = i * scale
+        lo = int(np.floor(pos))
+        lo = min(lo, in_size - 2)
+        frac = pos - lo
+        m[i, lo] += 1.0 - frac
+        m[i, lo + 1] += frac
+    return m
+
+
+def upsample_matrix(in_size: int, out_size: int, dtype=jnp.float32):
+    """(out_size, in_size) align-corners bilinear interpolation matrix."""
+    return jnp.asarray(_upsample_matrix_np(in_size, out_size), dtype=dtype)
+
+
+def resize_bilinear_align_corners(x, size):
+    """Bilinear align_corners=True resize of NHWC ``x`` to ``size=(H, W)``."""
+    oh, ow = size
+    h, w = x.shape[-3], x.shape[-2]
+    uh = upsample_matrix(h, oh, x.dtype)
+    uw = upsample_matrix(w, ow, x.dtype)
+    return jnp.einsum(
+        "...hwc,ph,qw->...pqc", x, uh, uw, precision=jax.lax.Precision.HIGHEST
+    )
