@@ -1,0 +1,16 @@
+from .density import gaussian_density_map, generate_density_maps
+from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD
+from .batching import ShardedBatcher, Batch, pad_batch
+from .synthetic import make_synthetic_dataset
+
+__all__ = [
+    "gaussian_density_map",
+    "generate_density_maps",
+    "CrowdDataset",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "ShardedBatcher",
+    "Batch",
+    "pad_batch",
+    "make_synthetic_dataset",
+]

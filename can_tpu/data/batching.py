@@ -1,0 +1,162 @@
+"""Static-shape batching for variable-resolution images + host sharding.
+
+The reference handles variable resolution with batch_size=1 and fully dynamic
+shapes (reference: train.py:84-91,177) — a non-starter under XLA, where every
+distinct shape is a recompile.  TPU-first design:
+
+* **Shape bucketing.** Items are grouped by their post-snap (H, W) — either
+  exactly (``pad_multiple=None``: zero padding, bit-exact reference math) or
+  rounded up to a multiple (bounded compile count for wild datasets).  Each
+  bucket shape compiles once; afterwards every batch of that shape reuses the
+  executable.
+* **Masking.** A per-image validity flag plus a per-cell mask over the 1/8
+  density grid make padded pixels and fill items contribute exactly zero to
+  loss/metrics, so MSE-sum and MAE match the reference's per-image math.
+* **Lockstep host sharding.** Every process computes the SAME global batch
+  schedule from the same seed (the dataset listing is sorted, the shuffle is
+  keyed on (seed, epoch)), then materialises only its own slice of each
+  global batch.  All hosts therefore step through identical batch counts and
+  shapes — the invariant ``jax.make_array_from_process_local_data`` needs —
+  which is the role ``DistributedSampler`` plays in the reference
+  (train.py:79-88).  Short batches are filled with ``sample_mask=0`` slots
+  instead of the reference's wrap-around duplicates, fixing its biased eval
+  denominator (train.py:157 divides by ``total_size`` incl. duplicates).
+* **Determinism.** The flip RNG is keyed on (seed, epoch, item index), so any
+  host resuming at any point reproduces the same stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Batch:
+    """One static-shape (per-host slice of a) batch.
+
+    image: (B, H, W, 3) float32, normalised; zero-padded outside each item.
+    dmap: (B, H/ds, W/ds, 1) float32 target density.
+    pixel_mask: (B, H/ds, W/ds, 1) float32 — 1 on valid density cells.
+    sample_mask: (B,) float32 — 1 for real items, 0 for fill slots.
+    """
+
+    image: np.ndarray
+    dmap: np.ndarray
+    pixel_mask: np.ndarray
+    sample_mask: np.ndarray
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.sample_mask.sum())
+
+
+def pad_batch(items, bucket_hw: Tuple[int, int], batch_size: int,
+              valid_flags, ds: int) -> Batch:
+    """Assemble variable-size (img, dmap) numpy pairs into one padded Batch."""
+    bh, bw = bucket_hw
+    gh, gw = bh // ds, bw // ds
+    image = np.zeros((batch_size, bh, bw, 3), np.float32)
+    dmap = np.zeros((batch_size, gh, gw, 1), np.float32)
+    pixel_mask = np.zeros((batch_size, gh, gw, 1), np.float32)
+    sample_mask = np.zeros((batch_size,), np.float32)
+    for slot, ((img, dm), valid) in enumerate(zip(items, valid_flags)):
+        h, w = img.shape[:2]
+        image[slot, :h, :w] = img
+        dmap[slot, : h // ds, : w // ds] = dm
+        pixel_mask[slot, : h // ds, : w // ds] = 1.0
+        sample_mask[slot] = float(valid)
+    return Batch(image, dmap, pixel_mask, sample_mask)
+
+
+class ShardedBatcher:
+    """Shuffled, shape-bucketed, lockstep-sharded batch iterator.
+
+    dataset: needs ``__len__``, ``snapped_shape(i) -> (H, W)`` and
+      ``__getitem__(i, rng) -> (img HWC, dmap hw1)``.
+    batch_size: items **per host** per emitted batch; the global batch is
+      ``batch_size * process_count``.
+    pad_multiple: None → bucket by exact snapped shape (reference-exact
+      math); int (multiple of ``ds``) → round H, W up to it (fewer compiles).
+    """
+
+    def __init__(self, dataset, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0, process_index: int = 0, process_count: int = 1,
+                 pad_multiple: Optional[int] = None, ds: int = 8):
+        if pad_multiple is not None and pad_multiple % ds != 0:
+            raise ValueError(
+                f"pad_multiple ({pad_multiple}) must be a multiple of the "
+                f"density downsample factor ({ds})")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.pad_multiple = pad_multiple
+        self.ds = int(ds)
+        # snapped shapes are immutable per item: cache them so repeated
+        # schedule builds (batches_per_epoch + every epoch) don't re-open
+        # every image header
+        self._shape_cache: Dict[int, Tuple[int, int]] = {}
+
+    @property
+    def dataset_size(self) -> int:
+        """True dataset length — the unbiased eval denominator."""
+        return len(self.dataset)
+
+    def _bucket_key(self, hw: Tuple[int, int]) -> Tuple[int, int]:
+        if self.pad_multiple is None:
+            return hw
+        m = self.pad_multiple
+        return (math.ceil(hw[0] / m) * m, math.ceil(hw[1] / m) * m)
+
+    def global_schedule(self, epoch: int) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
+        """Deterministic global batch plan: [(bucket_hw, [(idx, valid)] of
+        length global_batch)] — identical on every host for a given
+        (seed, epoch)."""
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng((self.seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+        gbs = self.batch_size * self.process_count
+        pending: Dict[Tuple[int, int], List[Tuple[int, bool]]] = {}
+        schedule = []
+        for idx in order.tolist():
+            hw = self._shape_cache.get(idx)
+            if hw is None:
+                hw = self._shape_cache[idx] = self.dataset.snapped_shape(idx)
+            key = self._bucket_key(hw)
+            group = pending.setdefault(key, [])
+            group.append((idx, True))
+            if len(group) == gbs:
+                schedule.append((key, group))
+                pending[key] = []
+        for key, group in pending.items():
+            if group:
+                # fill dead slots (static shape, zero weight) instead of the
+                # reference's wrap-around duplicates.
+                group = group + [(group[0][0], False)] * (gbs - len(group))
+                schedule.append((key, group))
+        return schedule
+
+    def batches_per_epoch(self, epoch: int = 0) -> int:
+        return len(self.global_schedule(epoch))
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Yield this host's slice of each global batch, in schedule order."""
+        lo = self.process_index * self.batch_size
+        hi = lo + self.batch_size
+        for key, group in self.global_schedule(epoch):
+            yield self._materialise(key, group[lo:hi], epoch)
+
+    def _materialise(self, key, group, epoch: int) -> Batch:
+        items = []
+        for idx, _ in group:
+            rng = np.random.default_rng((self.seed, epoch, int(idx)))
+            items.append(self.dataset.__getitem__(int(idx), rng=rng))
+        return pad_batch(items, key, len(group), [v for _, v in group], self.ds)

@@ -1,0 +1,108 @@
+"""Variable-resolution crowd dataset pipeline (host-side, numpy).
+
+Re-implements the reference loader semantics
+(reference: model/CrowdDataset.py:16-70) with TPU-first output:
+
+* image read as RGB float in [0,1]; grayscale expanded to 3 channels
+  (CrowdDataset.py:38-43);
+* paired ``.npy`` density map (CrowdDataset.py:45-46);
+* 50% horizontal flip of both in the train phase (CrowdDataset.py:48-50) —
+  but driven by an explicit seeded ``numpy.random.Generator`` instead of the
+  reference's unseeded global ``random`` (train.py:66 seeds only CUDA);
+* H, W snapped *down* to multiples of ``gt_downsample`` (=8) via cv2 bilinear
+  resize; density map resized straight to (H/8, W/8) and rescaled by 8*8 to
+  conserve the head count (CrowdDataset.py:53-62);
+* ImageNet mean/std normalisation (CrowdDataset.py:64-66).
+
+Differences by design:
+
+* output is **NHWC float32** (TPU lane layout), not CHW torch tensors;
+* the ``gt_downsample <= 1`` path — a latent NameError in the reference
+  (CrowdDataset.py:53-69) — is implemented rather than crashing;
+* deterministic: item transforms take the RNG as an argument, so a given
+  (seed, epoch, index) always yields the same sample on every host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import cv2
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+def _read_image(path: str) -> np.ndarray:
+    """RGB float32 in [0,1], (H, W, 3)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        arr = np.asarray(im)
+    if arr.ndim == 2:  # grayscale -> 3 channels (reference :41-43)
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.shape[-1] == 4:  # drop alpha
+        arr = arr[..., :3]
+    if np.issubdtype(arr.dtype, np.integer):
+        # scale by the dtype's full range (uint8 -> /255, 16-bit PNG -> /65535)
+        return arr.astype(np.float32) / float(np.iinfo(arr.dtype).max)
+    return arr.astype(np.float32)
+
+
+class CrowdDataset:
+    """Indexable dataset of (image NHWC, density map (h, w, 1)) numpy pairs."""
+
+    def __init__(self, img_root: str, gt_dmap_root: str, *,
+                 gt_downsample: int = 8, phase: str = "train"):
+        self.img_root = img_root
+        self.gt_dmap_root = gt_dmap_root
+        self.gt_downsample = int(gt_downsample)
+        self.phase = phase
+        # sorted (the reference uses os.listdir order, which is fs-dependent;
+        # sorting makes sharding identical across hosts)
+        self.img_names = sorted(
+            f for f in os.listdir(img_root)
+            if os.path.isfile(os.path.join(img_root, f))
+        )
+
+    def __len__(self) -> int:
+        return len(self.img_names)
+
+    def snapped_shape(self, index: int) -> Tuple[int, int]:
+        """(H, W) the item will have after /8 snapping — cheap (header-only
+        read), used by the bucketing batcher to group shapes without decoding
+        full images."""
+        from PIL import Image
+
+        with Image.open(os.path.join(self.img_root, self.img_names[index])) as im:
+            w, h = im.size
+        ds = self.gt_downsample
+        if ds > 1:
+            return (h // ds) * ds, (w // ds) * ds
+        return h, w
+
+    def __getitem__(self, index: int,
+                    rng: Optional[np.random.Generator] = None):
+        name = self.img_names[index]
+        img = _read_image(os.path.join(self.img_root, name))
+        base, _ = os.path.splitext(name)
+        dmap = np.load(os.path.join(self.gt_dmap_root, base + ".npy"))
+        dmap = np.asarray(dmap, dtype=np.float32)
+
+        if self.phase == "train" and rng is not None and rng.integers(0, 2) == 1:
+            img = img[:, ::-1]
+            dmap = dmap[:, ::-1]
+
+        ds = self.gt_downsample
+        if ds > 1:
+            rows, cols = img.shape[0] // ds, img.shape[1] // ds
+            # cv2 bilinear, half-pixel centers — bit-exact with the reference
+            # (CrowdDataset.py:56-60).
+            img = cv2.resize(np.ascontiguousarray(img), (cols * ds, rows * ds))
+            dmap = cv2.resize(np.ascontiguousarray(dmap), (cols, rows))
+            dmap = dmap * ds * ds  # conserve count (reference :61-62)
+
+        img = (img - IMAGENET_MEAN) / IMAGENET_STD
+        return img.astype(np.float32), dmap[..., np.newaxis].astype(np.float32)

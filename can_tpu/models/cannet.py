@@ -177,6 +177,8 @@ def load_vgg16_frontend(params: dict, npz_path: str) -> dict:
         b = jnp.asarray(data[f"conv{i}_b"], dtype=p["b"].dtype)
         if w.shape != p["w"].shape:
             raise ValueError(f"conv{i}: npz shape {w.shape} != expected {p['w'].shape}")
+        if b.shape != p["b"].shape:
+            raise ValueError(f"conv{i}: bias shape {b.shape} != expected {p['b'].shape}")
         frontend.append({"w": w, "b": b})
     out["frontend"] = frontend
     return out

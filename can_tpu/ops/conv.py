@@ -42,8 +42,15 @@ def conv2d(x, w, b=None, *, dilation: int = 1, padding=None, precision=None):
 
 
 def conv1x1(x, w, b=None, *, precision=None):
-    """1x1 conv == channel matmul. w: (Cin, Cout)."""
-    out = jnp.einsum("...c,cd->...d", x, w, precision=precision)
+    """1x1 conv == channel matmul. w: (Cin, Cout). Accumulates in f32 under
+    bf16 compute (like conv2d) before casting back."""
+    out = jnp.einsum(
+        "...c,cd->...d",
+        x,
+        w,
+        precision=precision,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
     if b is not None:
-        out = out + b
+        out = out + b.astype(out.dtype)
     return out.astype(x.dtype)

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from can_tpu.ops.separable import separable_hw_contract
+
 
 @functools.lru_cache(maxsize=None)
 def _adaptive_pool_matrix_np(in_size: int, out_size: int) -> np.ndarray:
@@ -44,13 +46,10 @@ def adaptive_avg_pool2d(x, output_size):
         output_size = (output_size, output_size)
     sh, sw = output_size
     h, w = x.shape[-3], x.shape[-2]
-    ph = adaptive_pool_matrix(h, sh, x.dtype)
-    pw = adaptive_pool_matrix(w, sw, x.dtype)
-    # HIGHEST: these contractions are tiny (S <= 6 output bins) but parity
-    # critical — default matmul precision costs ~1e-3 relative error.
-    return jnp.einsum(
-        "...hwc,ph,qw->...pqc", x, ph, pw, precision=jax.lax.Precision.HIGHEST
-    )
+    # f32 matrices (bf16 would quantize exact coefficients like 1/3); the
+    # contraction is tiny (S <= 6 output bins) but parity critical.
+    return separable_hw_contract(x, adaptive_pool_matrix(h, sh),
+                                 adaptive_pool_matrix(w, sw))
 
 
 def max_pool2d(x, window: int = 2, stride: int = 2):

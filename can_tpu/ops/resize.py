@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from can_tpu.ops.separable import separable_hw_contract
+
 
 @functools.lru_cache(maxsize=None)
 def _upsample_matrix_np(in_size: int, out_size: int) -> np.ndarray:
@@ -50,8 +52,7 @@ def resize_bilinear_align_corners(x, size):
     """Bilinear align_corners=True resize of NHWC ``x`` to ``size=(H, W)``."""
     oh, ow = size
     h, w = x.shape[-3], x.shape[-2]
-    uh = upsample_matrix(h, oh, x.dtype)
-    uw = upsample_matrix(w, ow, x.dtype)
-    return jnp.einsum(
-        "...hwc,ph,qw->...pqc", x, uh, uw, precision=jax.lax.Precision.HIGHEST
-    )
+    # f32 matrices + f32 accumulation even under bf16 compute (exact
+    # interpolation coefficients must not be quantized).
+    return separable_hw_contract(x, upsample_matrix(h, oh),
+                                 upsample_matrix(w, ow))

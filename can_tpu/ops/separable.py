@@ -1,0 +1,29 @@
+"""Shared separable H/W contraction used by adaptive pooling and resize.
+
+Both ops are linear maps per spatial axis with tiny static matrices; this is
+the single precision-policy point for them: HIGHEST matmul precision, f32
+coefficient matrices and f32 accumulation even under bf16 compute, result
+cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def separable_hw_contract(x, mh, mw):
+    """einsum('...hwc,ph,qw->...pqc') with f32 accumulation.
+
+    x: (..., H, W, C); mh: (P, H) f32; mw: (Q, W) f32 -> (..., P, Q, C) in
+    x.dtype.
+    """
+    out = jnp.einsum(
+        "...hwc,ph,qw->...pqc",
+        x,
+        mh,
+        mw,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(x.dtype)

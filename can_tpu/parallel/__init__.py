@@ -1,0 +1,31 @@
+from .mesh import make_mesh, batch_sharding, replicated_sharding
+from .runtime import (
+    init_runtime,
+    shutdown_runtime,
+    process_index,
+    process_count,
+    is_main_process,
+    barrier,
+    reduce_value,
+)
+from .data_parallel import (
+    make_global_batch,
+    make_dp_train_step,
+    make_dp_eval_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "init_runtime",
+    "shutdown_runtime",
+    "process_index",
+    "process_count",
+    "is_main_process",
+    "barrier",
+    "reduce_value",
+    "make_global_batch",
+    "make_dp_train_step",
+    "make_dp_eval_step",
+]

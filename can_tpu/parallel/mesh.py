@@ -1,0 +1,62 @@
+"""Device-mesh construction — the TPU replacement for NCCL process groups.
+
+The reference's world is N OS processes x 1 GPU each, glued by a NCCL process
+group (reference: utils/distributed_utils.py:23-28).  On TPU the world is a
+``jax.sharding.Mesh`` over all chips; parallelism is expressed as shardings
+over named axes and XLA lowers the collectives onto ICI/DCN.
+
+Axes used by this framework:
+
+* ``data``    — batch-sharded data parallelism (the reference's DDP).
+* ``spatial`` — image-height sharding for very-high-resolution images
+  (context/sequence parallelism; see parallel/spatial.py).  The reference has
+  no equivalent — it handles high-res only via batch=1 (train.py:177).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(devices: Optional[Sequence] = None, *, dp: Optional[int] = None,
+              sp: int = 1) -> Mesh:
+    """Mesh of shape (dp, sp) over ``devices`` (default: all devices).
+
+    dp defaults to ``len(devices) // sp``.  ICI-friendly device order comes
+    from ``mesh_utils.create_device_mesh`` on real TPU topologies; we fall
+    back to a plain reshape for virtual/CPU device sets.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if dp is None:
+        if len(devices) % sp:
+            raise ValueError(f"{len(devices)} devices not divisible by sp={sp}")
+        dp = len(devices) // sp
+    if dp * sp != len(devices):
+        raise ValueError(f"dp*sp = {dp * sp} != {len(devices)} devices")
+    try:
+        dmesh = mesh_utils.create_device_mesh((dp, sp), devices=devices)
+    except Exception:
+        if devices[0].platform == "tpu":
+            # on real TPU a failure here is a genuine topology/config error;
+            # a silent reshape would quietly cost ICI bandwidth
+            raise
+        dmesh = np.asarray(devices).reshape(dp, sp)
+    return Mesh(dmesh, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params, optimizer state)."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,127 @@
+"""Multi-host runtime: rendezvous, topology queries, host-level collectives.
+
+TPU-native counterpart of the reference's NCCL bootstrap
+(reference: utils/distributed_utils.py:7-70):
+
+* ``init_distributed_mode`` (env-var / SLURM rendezvous + nccl init_process_group)
+  → ``init_runtime`` calling ``jax.distributed.initialize`` when a coordinator
+  is configured, else single-process no-op (the reference degrades the same
+  way, distributed_utils.py:15-18).
+* ``get_rank / get_world_size / is_main_process`` → ``process_index /
+  process_count / is_main_process`` (JAX process == host, not chip).
+* ``dist.barrier`` → ``barrier()`` via multihost sync.
+* ``reduce_value`` (dist.all_reduce of a metric tensor, distributed_utils.py:60-70)
+  → ``reduce_value`` — but note: in this framework cross-chip reductions of
+  loss/metrics happen *inside* compiled programs as ``lax.psum`` / GSPMD
+  shardings; this host-level helper exists only for values computed outside
+  jit (e.g. host-side counters).
+
+Identical-init protocol: unnecessary here.  The reference makes replicas agree
+by rank0-saving random weights to a tempfile + barrier + all-load
+(train.py:104-114); with JAX, every process seeds the same PRNG key and gets
+bit-identical params by construction.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+_initialized = False
+
+
+def init_runtime(*, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None) -> dict:
+    """Initialise multi-host JAX if a coordinator is configured.
+
+    Rendezvous sources, in priority order (mirroring the reference's env-var /
+    SLURM probing, distributed_utils.py:8-14):
+
+    1. explicit arguments;
+    2. ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID`` env vars;
+    3. TPU pod metadata (``jax.distributed.initialize()`` with no args
+       auto-detects on Cloud TPU when JAX_COORDINATOR_ADDRESS etc. are set);
+    4. none found → single-process mode (no-op), like the reference's
+       "Not using distributed mode" fallback.
+
+    Returns a small topology dict for logging.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    elif process_id is None and "SLURM_PROCID" in os.environ:
+        process_id = int(os.environ["SLURM_PROCID"])
+
+    if not _initialized:
+        if coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            _initialized = True
+        elif any(k in os.environ for k in (
+                "JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS",
+                "TPU_WORKER_HOSTNAMES")):
+            # Cloud TPU pod metadata present: no-arg initialize auto-detects
+            # topology (rendezvous source 3).
+            jax.distributed.initialize()
+            _initialized = True
+    return {
+        "process_index": process_index(),
+        "process_count": process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def shutdown_runtime() -> None:
+    """Tear down the distributed client (the reference defines ``cleanup()``
+    but never calls it, train.py — we do, from the CLI's finally block)."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until all processes arrive (reference: dist.barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def reduce_value(value, average: bool = True):
+    """Sum (or average) a host-side scalar/array across processes.
+
+    No-op at world size 1, like the reference (distributed_utils.py:62-63).
+    """
+    if jax.process_count() < 2:
+        return value
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    total = gathered.sum(axis=0)
+    return total / jax.process_count() if average else total

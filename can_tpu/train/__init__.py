@@ -1,0 +1,18 @@
+from .loss import masked_mse_sum, density_counts
+from .state import TrainState, create_train_state, make_optimizer, make_lr_schedule
+from .steps import make_train_step, make_eval_step, NonFiniteLossError
+from .loop import train_one_epoch, evaluate
+
+__all__ = [
+    "masked_mse_sum",
+    "density_counts",
+    "TrainState",
+    "create_train_state",
+    "make_optimizer",
+    "make_lr_schedule",
+    "make_train_step",
+    "make_eval_step",
+    "NonFiniteLossError",
+    "train_one_epoch",
+    "evaluate",
+]

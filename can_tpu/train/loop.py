@@ -1,0 +1,104 @@
+"""Host-side epoch loops (the reference's utils/train_eval_utils.py re-done).
+
+Differences from the reference, by design:
+
+* metrics returned by the compiled steps are already global (GSPMD reduces
+  across chips in-program) — no per-step ``reduce_value`` collective
+  (reference :39) and no end-of-epoch ``cuda.synchronize`` (:55-57); we
+  block once per epoch on the last metric fetch.
+* non-finite loss raises ``NonFiniteLossError`` on every host
+  simultaneously instead of rank-locally ``sys.exit(1)``-ing into a NCCL
+  deadlock (reference :48-50; SURVEY §5).  The check is lagged one step so
+  it never forces a host<->device sync inside the step pipeline.
+* eval MAE/MSE denominators use the true dataset size, not the
+  padding-inflated sampler total (reference train.py:157 bias).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterable, Optional
+
+import jax
+import numpy as np
+
+from can_tpu.train.steps import NonFiniteLossError
+
+
+def _progress(iterable, *, enabled: bool, desc: str, total: Optional[int]):
+    if not enabled:
+        return iterable
+    try:
+        from tqdm import tqdm
+
+        return tqdm(iterable, desc=desc, total=total)
+    except ImportError:  # pragma: no cover
+        return iterable
+
+
+def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
+                    put_fn: Callable, epoch: int = 0, show_progress: bool = True,
+                    check_finite: bool = True, total: Optional[int] = None):
+    """Run one epoch; returns (state, mean_per_image_loss).
+
+    train_step: jitted (state, batch_dict) -> (state, metrics).
+    batches: iterable of data.Batch (this host's slices).
+    put_fn: Batch -> device batch dict (parallel.make_global_batch partial).
+    """
+    loss_sum = 0.0
+    img_sum = 0.0
+    prev = None  # lagged (still-async) metrics for the non-finite check
+    it = _progress(batches, enabled=show_progress, desc=f"epoch {epoch}",
+                   total=total)
+    for batch in it:
+        state, metrics = train_step(state, put_fn(batch))
+        if prev is not None:
+            loss_sum, img_sum = _accumulate(prev, loss_sum, img_sum,
+                                            check_finite, epoch)
+        prev = metrics
+        if show_progress and hasattr(it, "set_postfix") and img_sum:
+            it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
+    if prev is not None:
+        loss_sum, img_sum = _accumulate(prev, loss_sum, img_sum, check_finite,
+                                        epoch)
+    mean_loss = loss_sum / max(img_sum, 1.0)
+    return state, mean_loss
+
+
+def _accumulate(metrics, loss_sum, img_sum, check_finite, epoch):
+    loss = float(metrics["loss"])
+    if check_finite and not math.isfinite(loss):
+        # every host computes the same replicated loss, so every host raises:
+        # a clean global abort, not the reference's one-rank exit + deadlock.
+        raise NonFiniteLossError(
+            f"non-finite loss {loss} in epoch {epoch}; aborting all hosts")
+    return loss_sum + loss, img_sum + float(metrics["num_valid"])
+
+
+def evaluate(eval_step: Callable, params, batches: Iterable, *,
+             put_fn: Callable, dataset_size: int, show_progress: bool = False,
+             total: Optional[int] = None) -> dict:
+    """Dataset MAE and (paper-style) RMSE over the eval set.
+
+    eval_step returns global sums (see train/steps.py), so accumulating on
+    one host and dividing by the TRUE dataset size gives the exact
+    reference metric ``mae = Σ|et-gt| / N`` (reference
+    utils/train_eval_utils.py:83,136, minus its padding bias).
+    """
+    abs_sum = 0.0
+    sq_sum = 0.0
+    n_seen = 0.0
+    it = _progress(batches, enabled=show_progress, desc="eval", total=total)
+    for batch in it:
+        m = jax.device_get(eval_step(params, put_fn(batch)))
+        abs_sum += float(m["abs_err_sum"])
+        sq_sum += float(m["sq_err_sum"])
+        n_seen += float(m["num_valid"])
+    if int(n_seen) != dataset_size:
+        raise RuntimeError(
+            f"eval saw {int(n_seen)} valid samples, expected {dataset_size}")
+    return {
+        "mae": abs_sum / dataset_size,
+        "mse": float(np.sqrt(sq_sum / dataset_size)),
+        "num_images": dataset_size,
+    }

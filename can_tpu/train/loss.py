@@ -1,0 +1,37 @@
+"""Losses and count metrics for density-map regression, mask-aware.
+
+The reference's loss is ``nn.MSELoss(reduction='sum')`` over a batch-1
+variable-shape density map (reference: utils/train_eval_utils.py:20,37).
+Here batches are padded to static shapes (data/batching.py), so every term is
+multiplied by the density-grid validity mask — padded cells and zero-weight
+fill slots contribute exactly 0, keeping the math equal to the reference's
+per-image sums.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _full_mask(batch) -> jnp.ndarray:
+    """(B, h, w, 1) combined pixel+sample mask."""
+    return batch["pixel_mask"] * batch["sample_mask"][:, None, None, None]
+
+
+def masked_mse_sum(pred, batch) -> jnp.ndarray:
+    """Sum of squared errors over valid density cells (MSELoss(reduction='sum'))."""
+    mask = _full_mask(batch)
+    err = (pred.astype(jnp.float32) - batch["dmap"]) * mask
+    return jnp.sum(err * err)
+
+
+def density_counts(pred, batch):
+    """Per-image predicted and ground-truth head counts (masked sums).
+
+    The reference evaluates per image: ``|et.sum() - gt.sum()|``
+    (utils/train_eval_utils.py:83).  Returns (et, gt) each (B,).
+    """
+    mask = _full_mask(batch)
+    et = jnp.sum(pred.astype(jnp.float32) * mask, axis=(1, 2, 3))
+    gt = jnp.sum(batch["dmap"] * mask, axis=(1, 2, 3))
+    return et, gt

@@ -1,0 +1,49 @@
+"""Train state + optimizer, mirroring the reference's SGD recipe.
+
+Reference recipe (train.py:25,125-126,179): SGD, momentum 0.95, weight decay
+0, base lr 1e-7 scaled linearly by world size.  The reference parses ``--lrf``
+but never uses it (SURVEY §5 quirk); here it is real — a cosine decay from
+``lr`` to ``lr * lrf`` over the training run, off by default (lrf=1.0 keeps
+the reference's constant-lr behaviour).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_lr_schedule(base_lr: float, *, world_size: int = 1,
+                     total_steps: Optional[int] = None,
+                     lrf: float = 1.0) -> Callable:
+    """lr(step): base_lr x world_size, optionally cosine-decayed to x lrf."""
+    peak = base_lr * world_size  # linear scaling rule (reference train.py:25)
+    if lrf == 1.0 or total_steps is None:
+        return optax.constant_schedule(peak)
+    return optax.cosine_decay_schedule(peak, total_steps, alpha=lrf)
+
+
+def make_optimizer(lr_schedule, *, momentum: float = 0.95,
+                   weight_decay: float = 0.0) -> optax.GradientTransformation:
+    if weight_decay:
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.sgd(lr_schedule, momentum=momentum),
+        )
+    return optax.sgd(lr_schedule, momentum=momentum)
+
+
+def create_train_state(params, optimizer: optax.GradientTransformation) -> TrainState:
+    import jax.numpy as jnp
+
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=optimizer.init(params))

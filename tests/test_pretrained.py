@@ -1,0 +1,70 @@
+"""Round-trip test pinning the VGG-16 conversion contract.
+
+tools/convert_vgg16.py writes conv{i}_w (HWIO) / conv{i}_b from a torchvision
+vgg16 state dict; can_tpu.models.load_vgg16_frontend consumes it.  A synthetic
+state dict stands in for real pretrained weights (no egress here).
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, "tools")
+from convert_vgg16 import VGG16_CONV_FEATURE_IDX, state_dict_to_npz_arrays  # noqa: E402
+
+from can_tpu.models import FRONTEND_CFG, cannet_init, load_vgg16_frontend  # noqa: E402
+
+
+def synthetic_vgg16_state_dict(seed=0):
+    rng = np.random.default_rng(seed)
+    sd = {}
+    cin = 3
+    chans = [v for v in FRONTEND_CFG if v != "M"] + [512, 512, 512]  # full VGG16
+    for k, cout in zip(VGG16_CONV_FEATURE_IDX + (24, 26, 28), chans):
+        sd[f"features.{k}.weight"] = rng.normal(size=(cout, cin, 3, 3)).astype(np.float32)
+        sd[f"features.{k}.bias"] = rng.normal(size=(cout,)).astype(np.float32)
+        cin = cout
+    return sd
+
+
+def test_round_trip_into_frontend(tmp_path):
+    sd = synthetic_vgg16_state_dict()
+    arrays = state_dict_to_npz_arrays(sd)
+    npz = tmp_path / "vgg16_frontend.npz"
+    np.savez(npz, **arrays)
+
+    params = cannet_init(jax.random.key(0))
+    loaded = load_vgg16_frontend(params, str(npz))
+    # every frontend conv must carry the converted weights, OIHW->HWIO
+    conv_chans = [v for v in FRONTEND_CFG if v != "M"]
+    assert len(loaded["frontend"]) == len(conv_chans) == 10
+    for i, k in enumerate(VGG16_CONV_FEATURE_IDX):
+        want_w = np.transpose(sd[f"features.{k}.weight"], (2, 3, 1, 0))
+        np.testing.assert_array_equal(np.asarray(loaded["frontend"][i]["w"]), want_w)
+        np.testing.assert_array_equal(np.asarray(loaded["frontend"][i]["b"]),
+                                      sd[f"features.{k}.bias"])
+    # non-frontend params untouched
+    assert loaded["output"] is params["output"]
+
+
+def test_bad_shapes_rejected(tmp_path):
+    sd = synthetic_vgg16_state_dict()
+    arrays = state_dict_to_npz_arrays(sd)
+    params = cannet_init(jax.random.key(0))
+
+    bad_w = dict(arrays)
+    bad_w["conv3_w"] = bad_w["conv3_w"].transpose(3, 2, 0, 1)  # wrong layout
+    p = tmp_path / "bad_w.npz"
+    np.savez(p, **bad_w)
+    with pytest.raises(ValueError, match="conv3"):
+        load_vgg16_frontend(params, str(p))
+
+    bad_b = dict(arrays)
+    bad_b["conv2_b"] = bad_b["conv2_b"][:1]  # broadcastable but wrong
+    p = tmp_path / "bad_b.npz"
+    np.savez(p, **bad_b)
+    with pytest.raises(ValueError, match="conv2.*bias"):
+        load_vgg16_frontend(params, str(p))

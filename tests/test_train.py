@@ -1,0 +1,211 @@
+"""Train/eval step + loop tests on a virtual 8-device CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from can_tpu.data import Batch, CrowdDataset, ShardedBatcher, make_synthetic_dataset
+from can_tpu.parallel import (
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_global_batch,
+    make_mesh,
+)
+from can_tpu.train import (
+    NonFiniteLossError,
+    create_train_state,
+    evaluate,
+    make_eval_step,
+    make_lr_schedule,
+    make_optimizer,
+    make_train_step,
+    train_one_epoch,
+)
+
+# --- tiny stand-in model: one 3x3 conv, stride-8 pooling to the 1/8 grid ---
+
+
+def tiny_init(key):
+    return {"w": jax.random.normal(key, (3, 3, 3, 1)) * 0.1,
+            "b": jnp.zeros((1,))}
+
+
+def tiny_apply(params, image, compute_dtype=None):
+    x = image if compute_dtype is None else image.astype(compute_dtype)
+    x = jax.lax.conv_general_dilated(
+        x, params["w"].astype(x.dtype), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + params["b"].astype(x.dtype)
+    # 8x8 mean pool * 64 == sum over the 8x8 block: maps to the density grid
+    return jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 8, 8, 1), (1, 8, 8, 1), "VALID")
+
+
+def random_batch(rng, b=8, h=64, w=64, valid=None):
+    sample_mask = np.ones((b,), np.float32)
+    if valid is not None:
+        sample_mask[valid:] = 0.0
+    return Batch(
+        image=rng.normal(size=(b, h, w, 3)).astype(np.float32),
+        dmap=rng.uniform(size=(b, h // 8, w // 8, 1)).astype(np.float32),
+        pixel_mask=np.ones((b, h // 8, w // 8, 1), np.float32),
+        sample_mask=sample_mask,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert jax.device_count() >= 8
+    return make_mesh(jax.devices()[:8])
+
+
+class TestDPTrainStep:
+    def test_sharded_equals_single_device(self, mesh8):
+        """GSPMD data-parallel math == the same program on one device."""
+        params = tiny_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-3, world_size=8))
+        batch = random_batch(np.random.default_rng(0))
+
+        s_dp = create_train_state(params, opt)
+        step_dp = make_dp_train_step(tiny_apply, opt, mesh8, donate=False)
+        gb = make_global_batch(batch, mesh8)
+        for _ in range(3):
+            s_dp, m_dp = step_dp(s_dp, gb)
+
+        s_1 = create_train_state(params, opt)
+        step_1 = jax.jit(make_train_step(tiny_apply, opt, grad_divisor=8))
+        db = {k: jnp.asarray(getattr(batch, k))
+              for k in ("image", "dmap", "pixel_mask", "sample_mask")}
+        for _ in range(3):
+            s_1, m_1 = step_1(s_1, db)
+
+        # reduction order differs between the 8-way psum and one flat sum;
+        # agreement is to float32 rounding, not bit-exact.
+        np.testing.assert_allclose(float(m_dp["loss"]), float(m_1["loss"]),
+                                   rtol=1e-4)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                    rtol=1e-3, atol=1e-6),
+            s_dp.params, s_1.params)
+
+    def test_fill_slots_contribute_nothing(self, mesh8):
+        """A batch padded with dead slots gives the same update as without."""
+        params = tiny_init(jax.random.key(1))
+        opt = make_optimizer(make_lr_schedule(1e-3))
+        rng = np.random.default_rng(1)
+        full = random_batch(rng, b=8)
+        # zero-weight the last 4 slots and scribble garbage into them
+        masked = Batch(full.image.copy(), full.dmap.copy(),
+                       full.pixel_mask.copy(), full.sample_mask.copy())
+        masked.sample_mask[4:] = 0.0
+        masked.image[4:] = 999.0
+        masked.dmap[4:] = -999.0
+
+        ref = Batch(full.image.copy(), full.dmap.copy(),
+                    full.pixel_mask.copy(), full.sample_mask.copy())
+        ref.sample_mask[4:] = 0.0
+
+        step = jax.jit(make_train_step(tiny_apply, opt))
+        to_d = lambda b: {k: jnp.asarray(getattr(b, k))
+                          for k in ("image", "dmap", "pixel_mask", "sample_mask")}
+        s_a, m_a = step(create_train_state(params, opt), to_d(masked))
+        s_b, m_b = step(create_train_state(params, opt), to_d(ref))
+        assert float(m_a["loss"]) == pytest.approx(float(m_b["loss"]))
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), s_a.params, s_b.params)
+
+    def test_sgd_momentum_matches_torch(self):
+        """optax SGD(momentum=.95) update == torch.optim.SGD on same grads
+        (reference recipe train.py:125-126)."""
+        import torch
+
+        w0 = np.random.default_rng(3).normal(size=(5,)).astype(np.float32)
+        grads = [np.random.default_rng(10 + i).normal(size=(5,)).astype(np.float32)
+                 for i in range(4)]
+        lr = 0.1
+
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch.optim.SGD([tw], lr=lr, momentum=0.95, weight_decay=0)
+        for g in grads:
+            tw.grad = torch.tensor(g)
+            topt.step()
+
+        opt = make_optimizer(make_lr_schedule(lr))
+        params = jnp.asarray(w0)
+        state = opt.init(params)
+        for g in grads:
+            up, state = opt.update(jnp.asarray(g), state, params)
+            params = params + up
+        np.testing.assert_allclose(np.asarray(params), tw.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLoops:
+    def test_loss_decreases_on_learnable_data(self, mesh8):
+        params = tiny_init(jax.random.key(2))
+        # MSE-sum losses are huge, hence the reference's tiny base lr
+        # (train.py:178: 1e-7); 1e-8 keeps the toy model monotone-stable.
+        opt = make_optimizer(make_lr_schedule(1e-8, world_size=8))
+        # the step donates its input state, so each run needs its own buffers
+        state = create_train_state(jax.tree.map(jnp.array, params), opt)
+        state2 = create_train_state(jax.tree.map(jnp.array, params), opt)
+        step = make_dp_train_step(tiny_apply, opt, mesh8)
+        put = lambda b: make_global_batch(b, mesh8)
+        rng = np.random.default_rng(5)
+        batches = [random_batch(rng) for _ in range(6)]
+        _, first = train_one_epoch(step, state, batches[:1], put_fn=put,
+                                   show_progress=False)
+        for ep in range(6):
+            state2, last = train_one_epoch(step, state2, batches, put_fn=put,
+                                           epoch=ep, show_progress=False)
+        assert last < first
+
+    def test_nonfinite_raises(self, mesh8):
+        def bad_apply(params, image, compute_dtype=None):
+            return tiny_apply(params, image) * jnp.nan
+
+        params = tiny_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-3))
+        step = make_dp_train_step(bad_apply, opt, mesh8)
+        put = lambda b: make_global_batch(b, mesh8)
+        with pytest.raises(NonFiniteLossError):
+            train_one_epoch(step, create_train_state(params, opt),
+                            [random_batch(np.random.default_rng(0))],
+                            put_fn=put, show_progress=False)
+
+    def test_evaluate_matches_per_image_reference_math(self, mesh8, tmp_path):
+        """Masked batched eval == the reference's batch-1 per-image MAE loop
+        (utils/train_eval_utils.py:83) on the same predictions."""
+        img_root, gt_root = make_synthetic_dataset(
+            str(tmp_path), 6, sizes=((64, 80), (80, 64)), seed=3)
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test")
+        params = tiny_init(jax.random.key(4))
+
+        # batch size must be divisible by the mesh's dp size; partial buckets
+        # are filled with zero-weight slots so the math stays per-image exact
+        batcher = ShardedBatcher(ds, 8, shuffle=False, pad_multiple=None)
+        ev = make_dp_eval_step(tiny_apply, mesh8)
+        res = evaluate(ev, params, batcher.epoch(0),
+                       put_fn=lambda b: make_global_batch(b, mesh8),
+                       dataset_size=batcher.dataset_size)
+
+        # reference math: per image |sum(et) - sum(gt)| / N, batch 1, no pads
+        abs_sum, sq_sum = 0.0, 0.0
+        for i in range(len(ds)):
+            img, dmap = ds[i]
+            et = tiny_apply(params, jnp.asarray(img)[None])
+            e = float(jnp.sum(et)) - float(dmap.sum())
+            abs_sum += abs(e)
+            sq_sum += e * e
+        assert res["mae"] == pytest.approx(abs_sum / len(ds), rel=1e-4)
+        assert res["mse"] == pytest.approx(np.sqrt(sq_sum / len(ds)), rel=1e-4)
+
+    def test_evaluate_counts_guard(self, mesh8):
+        ev = make_dp_eval_step(tiny_apply, mesh8)
+        params = tiny_init(jax.random.key(0))
+        with pytest.raises(RuntimeError):
+            evaluate(ev, params, [random_batch(np.random.default_rng(0))],
+                     put_fn=lambda b: make_global_batch(b, mesh8),
+                     dataset_size=999)

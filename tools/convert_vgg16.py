@@ -1,0 +1,69 @@
+"""Convert pretrained VGG-16 conv weights to the can_tpu frontend .npz.
+
+The reference downloads torchvision's VGG-16 at model construction and copies
+the first 20 tensors (10 conv weight+bias pairs) into the frontend by ordinal
+position (reference: model/CANNet.py:26-35).  This tool does that conversion
+ONCE, offline, producing ``vgg16_frontend.npz`` with keys ``conv{i}_w``
+(HWIO) / ``conv{i}_b`` for i in 0..9 — the contract consumed by
+``can_tpu.models.load_vgg16_frontend``.
+
+Sources, tried in order:
+1. ``--pth PATH`` — a torch state-dict file (torchvision ``vgg16`` layout,
+   ``features.{k}.weight`` OIHW), loaded with torch (CPU).
+2. torchvision download (only works where egress + torchvision exist).
+
+Usage: python tools/convert_vgg16.py --out vgg16_frontend.npz [--pth vgg16.pth]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+# torchvision vgg16 'features' indices of the first 10 conv layers
+# (conv positions in the [64,64,M,128,128,M,256,256,256,M,512,512,512] stack).
+VGG16_CONV_FEATURE_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21)
+
+
+def state_dict_to_npz_arrays(state_dict) -> dict:
+    """torchvision vgg16 state-dict -> {conv{i}_w (HWIO), conv{i}_b} arrays."""
+    out = {}
+    for i, k in enumerate(VGG16_CONV_FEATURE_IDX):
+        w = np.asarray(state_dict[f"features.{k}.weight"], dtype=np.float32)
+        b = np.asarray(state_dict[f"features.{k}.bias"], dtype=np.float32)
+        if w.ndim != 4:
+            raise ValueError(f"features.{k}.weight has ndim {w.ndim}, want 4")
+        out[f"conv{i}_w"] = np.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+        out[f"conv{i}_b"] = b
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="vgg16_frontend.npz")
+    ap.add_argument("--pth", default=None,
+                    help="local torch state-dict (.pth) for torchvision vgg16")
+    args = ap.parse_args()
+
+    if args.pth:
+        import torch
+
+        sd = torch.load(args.pth, map_location="cpu", weights_only=True)
+        if hasattr(sd, "state_dict"):
+            sd = sd.state_dict()
+        sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+    else:
+        from torchvision import models  # needs egress + torchvision
+
+        sd = {k: v.numpy() for k, v in
+              models.vgg16(weights="IMAGENET1K_V1").state_dict().items()}
+
+    arrays = state_dict_to_npz_arrays(sd)
+    np.savez(args.out, **arrays)
+    print(f"wrote {args.out}: " +
+          ", ".join(f"{k}{v.shape}" for k, v in sorted(arrays.items())[:4]) + ", ...")
+
+
+if __name__ == "__main__":
+    main()
