@@ -1,0 +1,90 @@
+"""Benchmark: CANNet training throughput (images/sec) on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note: the reference publishes NO throughput numbers (BASELINE.md) —
+its only number is a quality claim (ShanghaiTech-A MAE ~62.3).  For
+``vs_baseline`` we use the BASELINE.json north star "≥ H100x8 DDP images/sec"
+prorated per chip: a DDP rank training CANNet (VGG-16 frontend, ~576x768
+crops, batch 1, fp32+cudnn) sustains roughly 25 img/s on one H100, so
+vs_baseline = (our img/s per chip) / 25.0.  One v5e chip at bf16 beating one
+H100 at fp32 on this CNN means the whole-pod target is met at equal chip
+counts.
+
+Config: batch 4 per chip of 576x768 synthetic images (ShanghaiTech-A scale),
+bf16 compute / f32 params, full train step (fwd + bwd + SGD update), steady
+state over 20 steps after 3 warmup steps.  Override via env:
+BENCH_BATCH, BENCH_H, BENCH_W, BENCH_STEPS, BENCH_F32=1.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from can_tpu.models import cannet_apply, cannet_init
+    from can_tpu.parallel import (
+        make_dp_train_step,
+        make_global_batch,
+        make_mesh,
+    )
+    from can_tpu.data.batching import Batch
+    from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+
+    b = int(os.environ.get("BENCH_BATCH", "4"))
+    h = int(os.environ.get("BENCH_H", "576"))
+    w = int(os.environ.get("BENCH_W", "768"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = 3
+    compute_dtype = None if os.environ.get("BENCH_F32") else jnp.bfloat16
+
+    ndev = jax.device_count()
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+    local_b = b * ndev  # single process: local == global
+    batch = Batch(
+        image=rng.normal(size=(local_b, h, w, 3)).astype(np.float32),
+        dmap=rng.uniform(size=(local_b, h // 8, w // 8, 1)).astype(np.float32),
+        pixel_mask=np.ones((local_b, h // 8, w // 8, 1), np.float32),
+        sample_mask=np.ones((local_b,), np.float32),
+    )
+    gbatch = make_global_batch(batch, mesh)
+
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh,
+                              compute_dtype=compute_dtype)
+
+    # fence with an actual D2H fetch: over the axon tunnel
+    # block_until_ready() returns immediately, only materialising a value
+    # truly waits for the chained device work
+    for _ in range(warmup):
+        state, metrics = step(state, gbatch)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, gbatch)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite bench loss {loss}"
+
+    img_per_s = local_b * steps / dt
+    per_chip = img_per_s / ndev
+    print(json.dumps({
+        "metric": f"cannet_train_img_per_s_{h}x{w}_b{b}"
+                  f"{'_f32' if compute_dtype is None else '_bf16'}",
+        "value": round(img_per_s, 3),
+        "unit": "images/sec",
+        "vs_baseline": round(per_chip / 25.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Shared CLI plumbing: dataset roots, mesh/batch arithmetic, step caches."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from can_tpu.data import CrowdDataset
+from can_tpu.parallel import make_mesh
+from can_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+
+def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:
+    """ShanghaiTech-style layout (the reference hardcodes these path pairs,
+    train.py:49-57): <root>/<split>_data/images + .../ground_truth."""
+    base = os.path.join(data_root, f"{split}_data")
+    img, gt = os.path.join(base, "images"), os.path.join(base, "ground_truth")
+    for p in (img, gt):
+        if not os.path.isdir(p):
+            raise FileNotFoundError(
+                f"expected dataset directory {p} (ShanghaiTech layout: "
+                f"<data_root>/{split}_data/{{images,ground_truth}})")
+    return img, gt
+
+
+def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:
+    """Mesh over all devices with ``sp`` spatial shards; returns
+    (mesh, per_host_batch, dp).
+
+    ``batch_size`` is PER DATA-PARALLEL REPLICA (the reference's per-GPU
+    batch, train.py:177); global batch = batch_size * dp.
+    """
+    ndev = jax.device_count()
+    if ndev % sp:
+        raise ValueError(f"--sp {sp} does not divide device count {ndev}")
+    dp = ndev // sp
+    mesh = make_mesh(dp=dp, sp=sp)
+    global_batch = batch_size * dp
+    nproc = jax.process_count()
+    if global_batch % nproc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {nproc}")
+    return mesh, global_batch // nproc, dp
+
+
+class SpatialStepCache:
+    """Per-image-shape cache of spatial train steps (each H x W bucket shape
+    compiles its own shard_map program, mirroring jit's per-shape cache)."""
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._steps: Dict[Tuple[int, int], object] = {}
+
+    def __call__(self, image_hw: Tuple[int, int]):
+        step = self._steps.get(image_hw)
+        if step is None:
+            step = self._steps[image_hw] = self._factory(image_hw)
+        return step

@@ -26,6 +26,9 @@ def conv2d(x, w, b=None, *, dilation: int = 1, padding=None, precision=None):
         pad = ((ph, ph), (pw, pw))
     else:
         pad = ((padding, padding), (padding, padding))
+    # NOTE: no preferred_element_type here — TPU's MXU already accumulates
+    # bf16 convs in f32 internally, and requesting an f32 output + downcast
+    # breaks the transpose rule (dtype-mismatched cotangent convs in grad).
     out = lax.conv_general_dilated(
         x,
         w,
@@ -34,7 +37,6 @@ def conv2d(x, w, b=None, *, dilation: int = 1, padding=None, precision=None):
         rhs_dilation=(dilation, dilation),
         dimension_numbers=_DIMS,
         precision=precision,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     if b is not None:
         out = out + b

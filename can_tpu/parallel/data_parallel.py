@@ -33,18 +33,25 @@ from can_tpu.parallel.mesh import DATA_AXIS
 from can_tpu.train.steps import make_eval_step, make_train_step
 
 
-def _batch_shardings(mesh: Mesh) -> dict:
+def _batch_shardings(mesh: Mesh, spatial: bool = False) -> dict:
+    from can_tpu.parallel.mesh import SPATIAL_AXIS
+
+    if spatial:
+        s = NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS, None, None))
+        return {"image": s, "dmap": s, "pixel_mask": s,
+                "sample_mask": NamedSharding(mesh, P(DATA_AXIS))}
     s = NamedSharding(mesh, P(DATA_AXIS))
     return {"image": s, "dmap": s, "pixel_mask": s, "sample_mask": s}
 
 
-def make_global_batch(batch: Batch, mesh: Mesh) -> dict:
-    """Local Batch slice -> dict of global jax.Arrays sharded over ``data``.
+def make_global_batch(batch: Batch, mesh: Mesh, *, spatial: bool = False) -> dict:
+    """Local Batch slice -> dict of global jax.Arrays sharded over ``data``
+    (and, with ``spatial=True``, image height over ``spatial``).
 
     Works single- or multi-process: the global leading dim is
     ``local_B * process_count`` and each process contributes its slice.
     """
-    shardings = _batch_shardings(mesh)
+    shardings = _batch_shardings(mesh, spatial)
     out = {}
     for name in ("image", "dmap", "pixel_mask", "sample_mask"):
         local = np.ascontiguousarray(getattr(batch, name))

@@ -34,6 +34,16 @@ import numpy as np
 _initialized = False
 
 
+def _multihost_metadata_present() -> bool:
+    """True only when pod metadata names MORE THAN ONE worker — a single
+    hostname (e.g. a tunnelled dev chip) is not a pod."""
+    if ("JAX_COORDINATOR_ADDRESS" in os.environ
+            or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
 def init_runtime(*, coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  process_id: Optional[int] = None) -> dict:
@@ -68,13 +78,17 @@ def init_runtime(*, coordinator_address: Optional[str] = None,
                 process_id=process_id,
             )
             _initialized = True
-        elif any(k in os.environ for k in (
-                "JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS",
-                "TPU_WORKER_HOSTNAMES")):
+        elif _multihost_metadata_present():
             # Cloud TPU pod metadata present: no-arg initialize auto-detects
             # topology (rendezvous source 3).
-            jax.distributed.initialize()
-            _initialized = True
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except (ValueError, RuntimeError) as e:
+                # metadata was a false positive (e.g. a tunnelled single
+                # chip) or the backend is already up — degrade to
+                # single-process like the reference (distributed_utils.py:15-18)
+                print(f"[runtime] distributed auto-init skipped: {e}")
     return {
         "process_index": process_index(),
         "process_count": process_count(),

@@ -1,0 +1,225 @@
+"""Spatial (context) parallelism: image-height sharding with halo exchange.
+
+The CNN analogue of ring attention / sequence parallelism — the framework's
+first-class answer to "long context".  The reference handles high-resolution
+images (UCF-QNRF scale) only by batch=1 on a single GPU (reference:
+train.py:177; SURVEY §5 "long-context: ABSENT"); here one image can span many
+chips:
+
+* activations are sharded along H over the ``spatial`` mesh axis;
+* every 3x3 (possibly dilated) conv first exchanges ``dilation`` boundary
+  rows with its neighbours via ``lax.ppermute`` over ICI (a halo exchange —
+  the structural twin of ring attention's block rotation).  Devices at the
+  global top/bottom receive zeros, which IS the conv's SAME zero padding, so
+  the sharded conv is numerically identical to the unsharded one;
+* adaptive average pooling contracts each shard against its column-slice of
+  the (out x H_global) pooling matrix and ``lax.psum``s the partials — a
+  global pooling tree over ICI;
+* align-corners upsampling from the (replicated) S x S context grid needs
+  only the row-slice of the interpolation matrix owned by each shard — no
+  communication at all;
+* max pooling stays local (shard heights are kept divisible by the total
+  /8 downsampling, so 2x2 windows never straddle a boundary).
+
+All of this plugs into the SAME model body via the ``LocalOps`` injection
+point (models/cannet.py) — the forward pass is written once and runs
+unsharded or H-sharded under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from can_tpu.models.cannet import LocalOps, cannet_apply
+from can_tpu.ops.pooling import adaptive_pool_matrix, max_pool2d
+from can_tpu.ops.resize import upsample_matrix
+from can_tpu.ops.separable import separable_hw_contract
+from can_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+from can_tpu.train.loss import masked_mse_sum
+
+
+def halo_exchange_rows(x: jax.Array, halo: int, axis_name: str,
+                       axis_size: int) -> jax.Array:
+    """Concatenate ``halo`` rows from each H-neighbour onto a (N, Hl, W, C)
+    block.  Global-edge shards receive zeros (= SAME zero padding)."""
+    if halo <= 0:
+        return x
+    # rows travelling "down" (shard i -> i+1): our top halo comes from above
+    from_above = lax.ppermute(
+        x[:, -halo:], axis_name, [(i, i + 1) for i in range(axis_size - 1)])
+    # rows travelling "up" (shard i -> i-1): our bottom halo comes from below
+    from_below = lax.ppermute(
+        x[:, :halo], axis_name, [(i + 1, i) for i in range(axis_size - 1)])
+    return jnp.concatenate([from_above, x, from_below], axis=1)
+
+
+def make_spatial_ops(axis_name: str, axis_size: int,
+                     feat_hw: Tuple[int, int]) -> LocalOps:
+    """LocalOps whose spatial primitives communicate over ``axis_name``.
+
+    feat_hw: GLOBAL feature-map (H/8, W) shape after the VGG frontend — the
+    upsample target and pooling-matrix extent.
+    """
+
+    def conv2d_sp(x, w, b=None, *, dilation: int = 1, padding=None,
+                  precision=None):
+        from can_tpu.ops.conv import conv2d
+
+        kh = w.shape[0]
+        halo = dilation * (kh // 2) if padding is None else padding
+        if kh == 1 or halo == 0:
+            return conv2d(x, w, b, dilation=dilation, padding=padding,
+                          precision=precision)
+        xp = halo_exchange_rows(x, halo, axis_name, axis_size)
+        # rows are already materialised (VALID); columns keep SAME padding
+        pw = dilation * (w.shape[1] // 2)
+        out = lax.conv_general_dilated(
+            xp, w, (1, 1), ((0, 0), (pw, pw)), rhs_dilation=(dilation, dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=precision,
+        )
+        if b is not None:
+            out = out + b.astype(out.dtype)
+        return out.astype(x.dtype)
+
+    def adaptive_pool_sp(x, output_size):
+        if isinstance(output_size, int):
+            output_size = (output_size, output_size)
+        sh, sw = output_size
+        hg, w = feat_hw[0], x.shape[-2]
+        hl = x.shape[-3]
+        idx = lax.axis_index(axis_name)
+        ph = adaptive_pool_matrix(hg, sh)  # (sh, Hg), f32
+        ph_local = lax.dynamic_slice_in_dim(ph, idx * hl, hl, axis=1)
+        partial_sum = separable_hw_contract(x, ph_local,
+                                            adaptive_pool_matrix(w, sw))
+        return lax.psum(partial_sum, axis_name)
+
+    def upsample_sp(x, size):
+        # x: replicated (N, S, S, C); produce only OUR rows of the target
+        hg, wg = size
+        hl = hg // axis_size
+        idx = lax.axis_index(axis_name)
+        uh = upsample_matrix(x.shape[-3], hg)  # (Hg, S)
+        uh_local = lax.dynamic_slice_in_dim(uh, idx * hl, hl, axis=0)  # (hl, S)
+        return separable_hw_contract(x, uh_local,
+                                     upsample_matrix(x.shape[-2], wg))
+
+    return LocalOps(
+        conv2d=conv2d_sp,
+        max_pool=max_pool2d,
+        adaptive_pool=adaptive_pool_sp,
+        upsample=upsample_sp,
+        global_hw=feat_hw,
+    )
+
+
+def _check_spatial_shapes(h: int, sp: int, ds: int = 8) -> None:
+    if h % (ds * sp) != 0:
+        raise ValueError(
+            f"image height {h} must be divisible by downsample*sp = {ds * sp} "
+            f"so max-pool windows never straddle shard boundaries "
+            f"(pad with data/batching.py pad_multiple={ds * sp})")
+    if sp > 1 and h // (ds * sp) < 2:
+        # the dilated backend convs exchange a 2-row halo at 1/8 resolution;
+        # a shard must own at least that many feature rows
+        raise ValueError(
+            f"image height {h} over sp={sp} leaves {h // (ds * sp)} feature "
+            f"row(s) per shard; need >= 2 (the dilated-conv halo). Use fewer "
+            f"spatial shards or taller images")
+
+
+def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
+                       compute_dtype=None) -> Callable:
+    """Jitted H-sharded forward: (params, image (N, H, W, 3)) -> density map.
+
+    The batch is sharded over ``data`` and H over ``spatial``; output density
+    map keeps the same layout.
+    """
+    sp = mesh.shape[SPATIAL_AXIS]
+    h, w = image_hw
+    _check_spatial_shapes(h, sp)
+    feat_hw = (h // 8, w // 8)
+    ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), P(DATA_AXIS, SPATIAL_AXIS, None, None)),
+             out_specs=P(DATA_AXIS, SPATIAL_AXIS, None, None),
+             check_vma=False)
+    def fwd(params, x):
+        return cannet_apply(params, x, ops=ops, compute_dtype=compute_dtype)
+
+    return jax.jit(fwd)
+
+
+def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
+                       compute_dtype=None, donate: bool = True) -> Callable:
+    """Jitted train step with BOTH data and spatial parallelism.
+
+    Batch dict layout: image (B, H, W, 3), dmap/pixel_mask (B, H/8, W/8, 1),
+    sample_mask (B,) — B sharded over ``data``, H over ``spatial``.
+    DDP-parity grad scaling divides by the data-parallel size only (the
+    spatial shards jointly compute ONE replica's gradient).
+    """
+    sp = mesh.shape[SPATIAL_AXIS]
+    h, w = image_hw
+    _check_spatial_shapes(h, sp)
+    feat_hw = (h // 8, w // 8)
+    ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw)
+
+    def sharded_apply(params, image, compute_dtype=compute_dtype):
+        return cannet_apply(params, image, ops=ops, compute_dtype=compute_dtype)
+
+    bspec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
+    batch_specs = {"image": bspec, "dmap": bspec, "pixel_mask": bspec,
+                   "sample_mask": P(DATA_AXIS)}
+
+    def wrapped(state, batch):
+        # run the whole step under one shard_map; loss/metrics psum'd global
+        def body(state, batch):
+            # Differentiate the LOCAL (per-shard) loss — no collective inside
+            # loss_fn, so the cotangent seed is an unambiguous 1 per shard —
+            # then explicitly psum grads and loss.  (Putting the psum inside
+            # loss_fn is a trap under check_vma=False: its transpose re-psums
+            # the cotangent, scaling every gradient by the mesh size.)
+            def loss_fn(params):
+                pred = sharded_apply(params, batch["image"])
+                local_sse = masked_mse_sum(pred, batch)
+                return local_sse / mesh.shape[DATA_AXIS], local_sse
+
+            grads, local_sse = jax.grad(loss_fn, has_aux=True)(state.params)
+            grads = jax.tree.map(
+                lambda g: lax.psum(g, (DATA_AXIS, SPATIAL_AXIS)), grads)
+            sse = lax.psum(local_sse, (DATA_AXIS, SPATIAL_AXIS))
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype),
+                                  state.params, updates)
+            metrics = {
+                "loss": sse,
+                "num_valid": lax.psum(jnp.sum(batch["sample_mask"]), DATA_AXIS),
+            }
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), metrics
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), batch_specs),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(state, batch)
+
+    repl = NamedSharding(mesh, P())
+    batch_shardings = {k: NamedSharding(mesh, v) for k, v in batch_specs.items()}
+    return jax.jit(
+        wrapped,
+        in_shardings=(repl, batch_shardings),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )

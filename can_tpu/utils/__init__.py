@@ -1,0 +1,12 @@
+from .checkpoint import CheckpointManager
+from .logging import MetricLogger
+from .viz import save_density_visualization
+from .profiling import StepTimer, profile_trace
+
+__all__ = [
+    "CheckpointManager",
+    "MetricLogger",
+    "save_density_visualization",
+    "StepTimer",
+    "profile_trace",
+]

@@ -1,0 +1,71 @@
+"""Orbax checkpointing: params + optimizer state + step + best metric.
+
+The reference saves only the (DDP-prefixed) model state dict, rank-0, on a
+best-eval-MAE policy, and resumes with ``strict=False`` losing optimizer
+momentum and the epoch counter (reference: train.py:98-102,158-162; SURVEY
+§5).  Here a checkpoint is the FULL train state, so resume continues the run
+bit-for-bit; writes happen once per cluster (Orbax is multihost-aware:
+non-primary hosts participate in the save of sharded arrays — with
+replicated params this reduces to primary-only writes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from can_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    """Best-metric + latest checkpointing of TrainState under ``directory``."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=lambda m: m["mae"],
+                best_mode="min",
+            ),
+        )
+
+    def save(self, epoch: int, state: TrainState, *, mae: float,
+             extra: Optional[dict] = None) -> bool:
+        """Save if this epoch's MAE is among the best (reference policy:
+        keep improving checkpoints, train.py:158-162)."""
+        metrics = {"mae": float(mae)}
+        if extra:
+            metrics.update({k: float(v) for k, v in extra.items()})
+        saved = self.manager.save(
+            epoch, args=self._ocp.args.StandardSave(state), metrics=metrics)
+        return bool(saved)
+
+    def restore(self, state: TrainState, *, epoch: Optional[int] = None) -> TrainState:
+        """Restore into the structure of ``state`` (the abstract target)."""
+        if epoch is None:
+            epoch = self.manager.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self.manager.restore(
+            epoch, args=self._ocp.args.StandardRestore(state))
+
+    def latest_epoch(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def best_epoch(self) -> Optional[int]:
+        return self.manager.best_step()
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()

@@ -1,0 +1,46 @@
+"""Metric logging: stdout + optional wandb, main-process-gated.
+
+The reference logs scalars and eval images to wandb from rank 0
+(reference: train.py:40-46,167-171; utils/train_eval_utils.py:120-128).
+wandb is optional here — absent or disabled it degrades to prints, and the
+CLI keeps working in air-gapped environments.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class MetricLogger:
+    def __init__(self, *, use_wandb: bool = False, project: str = "CANNet-tpu",
+                 group: str = "tpu-ddp", name: Optional[str] = None,
+                 config: Optional[dict] = None, enabled: bool = True):
+        self.enabled = enabled
+        self._wandb = None
+        if enabled and use_wandb:
+            try:
+                import wandb
+
+                wandb.init(project=project, group=group, name=name,
+                           config=config or {})
+                self._wandb = wandb
+            except ImportError:
+                print("[logging] wandb not installed; falling back to stdout")
+
+    def log(self, metrics: dict, *, step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        line = " ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                        for k, v in metrics.items())
+        print(f"[metrics]{'' if step is None else f' step {step}'} {line}")
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def log_images(self, paths: list, *, caption: str = "") -> None:
+        if self.enabled and self._wandb is not None:
+            self._wandb.log({
+                caption or "images": [self._wandb.Image(p) for p in paths]})
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()

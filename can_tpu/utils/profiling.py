@@ -1,0 +1,57 @@
+"""Profiling hooks: jax.profiler traces + wall-clock step timing.
+
+The reference has no profiling at all (SURVEY §5: "Tracing/profiling:
+ABSENT" — only tqdm bars).  TPU-first observability:
+
+* ``profile_trace(dir)`` captures an XLA/TPU trace viewable in TensorBoard /
+  Perfetto (device timelines, HLO ops, ICI collectives);
+* ``StepTimer`` measures steady-state step time with an explicit
+  ``block_until_ready`` fence — the JAX analogue of the reference's
+  ``cuda.synchronize`` timing hygiene (utils/train_eval_utils.py:55-57).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op if None)."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+class StepTimer:
+    """Rolling mean of step wall-times, excluding the first (compile) steps."""
+
+    def __init__(self, skip_first: int = 2):
+        self.skip_first = skip_first
+        self._count = 0
+        self._total = 0.0
+        self._last: Optional[float] = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def stop(self, result=None) -> float:
+        """Fence on ``result`` (if given) and record the elapsed time."""
+        if result is not None:
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self._last
+        self._count += 1
+        if self._count > self.skip_first:
+            self._total += dt
+        return dt
+
+    @property
+    def mean(self) -> float:
+        n = self._count - self.skip_first
+        return self._total / n if n > 0 else float("nan")

@@ -1,0 +1,52 @@
+"""Density-map visualization (the reference's eval-time sample overlays).
+
+Re-implements utils/train_eval_utils.py:88-118: inverse-normalize a sample
+image, render ground-truth and estimated density maps over it, save PNGs.
+Fixes the reference's inverse-std typo (0.255 where ImageNet's blue-channel
+std is 0.225, train_eval_utils.py:92-95) and takes NHWC numpy arrays.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from can_tpu.data.dataset import IMAGENET_MEAN, IMAGENET_STD
+
+
+def save_density_visualization(image: np.ndarray, gt_dmap: np.ndarray,
+                               et_dmap: np.ndarray, out_dir: str, *,
+                               tag: str = "sample") -> list:
+    """Write {tag}_img/gt/et PNGs under out_dir; returns the paths.
+
+    image: (H, W, 3) normalised; gt/et_dmap: (h, w) or (h, w, 1).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(out_dir, exist_ok=True)
+    img = np.asarray(image) * IMAGENET_STD + IMAGENET_MEAN  # un-normalise
+    img = np.clip(img, 0.0, 1.0)
+    gt = np.asarray(gt_dmap).squeeze()
+    et = np.asarray(et_dmap).squeeze()
+
+    paths = []
+    for name, data, cmap in (("img", img, None), ("gt", gt, "jet"),
+                             ("et", et, "jet")):
+        path = os.path.join(out_dir, f"{tag}_{name}.png")
+        plt.figure(figsize=(6, 4))
+        if cmap is None:
+            plt.imshow(data)
+            plt.title(tag)
+        else:
+            plt.imshow(data, cmap=cmap)
+            plt.title(f"{name} count={data.sum():.1f}")
+        plt.axis("off")
+        plt.savefig(path, bbox_inches="tight", dpi=100)
+        plt.close()
+        paths.append(path)
+    return paths

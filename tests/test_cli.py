@@ -1,0 +1,107 @@
+"""End-to-end CLI + checkpoint tests: train a couple of epochs on synthetic
+data on the 8-device CPU mesh, resume, then evaluate with the test CLI."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu.data import make_synthetic_dataset
+from can_tpu.models import cannet_init
+from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+from can_tpu.utils import CheckpointManager, StepTimer
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cli_data")
+    for split, n, seed in (("train", 8, 0), ("test", 4, 1)):
+        make_synthetic_dataset(os.path.join(str(root), f"{split}_data"), n,
+                               sizes=((64, 64), (64, 96)), seed=seed)
+    return str(root)
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        params = cannet_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-7))
+        state = create_train_state(params, opt)
+        state = state.replace(step=state.step + 5)
+
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        assert mgr.save(0, state, mae=50.0)
+        mgr.wait()
+
+        fresh = create_train_state(cannet_init(jax.random.key(1)), opt)
+        restored = mgr.restore(fresh)
+        assert int(restored.step) == 5
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            restored.params, state.params)
+        mgr.close()
+
+    def test_best_policy_keeps_lowest_mae(self, tmp_path):
+        params = cannet_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-7))
+        state = create_train_state(params, opt)
+        mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=1)
+        mgr.save(0, state, mae=60.0)
+        mgr.save(1, state, mae=40.0)  # best
+        mgr.save(2, state, mae=55.0)
+        mgr.wait()
+        assert mgr.best_epoch() == 1
+        mgr.close()
+
+
+class TestTrainCLI:
+    def test_train_eval_resume(self, data_root, tmp_path):
+        from can_tpu.cli.train import main as train_main
+        from can_tpu.cli.test import main as test_main
+
+        ckdir = str(tmp_path / "ckpt")
+        argv = ["--data_root", data_root, "--epochs", "2",
+                "--batch-size", "1", "--lr", "1e-7",
+                "--checkpoint-dir", ckdir, "--seed", "0"]
+        assert train_main(argv) == 0
+        assert os.path.isdir(ckdir)
+        ck = CheckpointManager(ckdir)
+        assert ck.latest_epoch() == 1
+        ck.close()
+
+        # resume for one more epoch from the saved state
+        argv_resume = ["--data_root", data_root, "--epochs", "3",
+                       "--batch-size", "1", "--lr", "1e-7",
+                       "--checkpoint-dir", ckdir,
+                       "--init_checkpoint", ckdir, "--seed", "0"]
+        assert train_main(argv_resume) == 0
+        ck = CheckpointManager(ckdir)
+        assert ck.latest_epoch() == 2
+        ck.close()
+
+        # evaluation CLI reads the same checkpoints
+        assert test_main(["--data_root", data_root,
+                          "--checkpoint-dir", ckdir,
+                          "--show-index", "0",
+                          "--out-dir", str(tmp_path / "viz")]) == 0
+        assert any(f.endswith(".png") for f in os.listdir(tmp_path / "viz"))
+
+    def test_spatial_mode_smoke(self, data_root, tmp_path):
+        from can_tpu.cli.train import main as train_main
+
+        argv = ["--data_root", data_root, "--epochs", "1",
+                "--batch-size", "2", "--sp", "4",
+                "--checkpoint-dir", str(tmp_path / "ck_sp"),
+                "--max-steps-per-epoch", "1", "--seed", "0"]
+        assert train_main(argv) == 0
+
+
+def test_step_timer_fences():
+    t = StepTimer(skip_first=1)
+    for _ in range(3):
+        t.start()
+        x = jnp.ones((100, 100)) @ jnp.ones((100, 100))
+        t.stop(x)
+    assert t.mean > 0
