@@ -16,7 +16,7 @@ import numpy as np
 
 from can_tpu.cli.common import dataset_roots
 from can_tpu.data import CrowdDataset, ShardedBatcher
-from can_tpu.models import cannet_apply, cannet_init
+from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
 from can_tpu.parallel import (
     init_runtime,
     make_dp_eval_step,
@@ -46,14 +46,17 @@ def parse_args(argv=None):
     p.add_argument("--out-dir", type=str, default="./eval_out")
     p.add_argument("--platform", type=str, default="default",
                    choices=["default", "cpu", "tpu"])
+    p.add_argument("--syncBN", action="store_true",
+                   help="checkpoint is the BatchNorm model variant")
     return p.parse_args(argv)
 
 
 def load_params(args):
-    """Restore params from the checkpoint manager (best epoch by default)."""
-    params = cannet_init(jax.random.key(args.seed))
+    """Restore (params, batch_stats) from the checkpoint manager (best epoch
+    by default)."""
+    params = cannet_init(jax.random.key(args.seed), batch_norm=args.syncBN)
     optimizer = make_optimizer(make_lr_schedule(1e-7))
-    state = create_train_state(params, optimizer)
+    state = create_train_state(params, optimizer, init_batch_stats(params))
     ckpt = CheckpointManager(args.checkpoint_dir)
     epoch = args.epoch
     if epoch is None:
@@ -63,7 +66,7 @@ def load_params(args):
     state = ckpt.restore(state, epoch=epoch)
     ckpt.close()
     print(f"[load] epoch {epoch} from {args.checkpoint_dir}")
-    return state.params
+    return state.params, state.batch_stats
 
 
 def main(argv=None) -> int:
@@ -72,7 +75,7 @@ def main(argv=None) -> int:
 
     apply_platform(args)
     init_runtime()
-    params = load_params(args)
+    params, batch_stats = load_params(args)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
 
     img_root, gt_root = dataset_roots(args.data_root, args.split)
@@ -88,13 +91,19 @@ def main(argv=None) -> int:
     eval_step = make_dp_eval_step(cannet_apply, mesh, compute_dtype=compute_dtype)
     metrics = evaluate(eval_step, params, batcher.epoch(0),
                        put_fn=lambda b: make_global_batch(b, mesh),
-                       dataset_size=batcher.dataset_size, show_progress=True)
+                       dataset_size=batcher.dataset_size, show_progress=True,
+                       batch_stats=batch_stats)
     print(f"[result] images={metrics['num_images']} "
           f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
     if args.show_index is not None:
         img, gt = ds[args.show_index]
-        et = jax.jit(cannet_apply)(params, jnp.asarray(img)[None])
+        if batch_stats is not None:
+            et = jax.jit(lambda p, x, bs: cannet_apply(
+                p, x, batch_stats=bs, train=False))(
+                    params, jnp.asarray(img)[None], batch_stats)
+        else:
+            et = jax.jit(cannet_apply)(params, jnp.asarray(img)[None])
         paths = save_density_visualization(
             img, gt, np.asarray(et)[0], args.out_dir,
             tag=f"{args.split}_{args.show_index}")

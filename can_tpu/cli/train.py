@@ -13,8 +13,9 @@ flags made real:
 * ``--lrf`` is a real cosine decay to lr*lrf (reference parses, never uses);
 * ``--seed`` gives full reproducibility (reference seeds only CUDA with
   time.time(), train.py:66,71);
-* ``--syncBN`` is accepted-but-no-op exactly like the reference (CANNet has
-  no BN layers, SURVEY §2);
+* ``--syncBN`` trains the real BatchNorm variant of the model with
+  cross-replica statistics (the reference's flag is a no-op because its
+  CANNet has no BN layers, SURVEY §2);
 * eval MAE uses the true dataset size (reference divides by the
   padding-inflated sampler total, train.py:157).
 """
@@ -32,7 +33,12 @@ import numpy as np
 
 from can_tpu.cli.common import SpatialStepCache, build_mesh_and_batch, dataset_roots
 from can_tpu.data import CrowdDataset, ShardedBatcher
-from can_tpu.models import cannet_apply, cannet_init, load_vgg16_frontend
+from can_tpu.models import (
+    cannet_apply,
+    cannet_init,
+    init_batch_stats,
+    load_vgg16_frontend,
+)
 from can_tpu.parallel import (
     init_runtime,
     is_main_process,
@@ -65,7 +71,10 @@ def parse_args(argv=None):
     p.add_argument("--lrf", type=float, default=1.0,
                    help="final lr fraction for cosine decay (1.0 = constant)")
     p.add_argument("--syncBN", action="store_true",
-                   help="accepted for parity; no-op (CANNet has no BN layers)")
+                   help="train the BatchNorm variant of CANNet; batch stats "
+                        "are computed over the global sharded batch, i.e. "
+                        "cross-replica synchronized (the reference's flag is "
+                        "a no-op because its model has no BN layers)")
     p.add_argument("--wandb", action="store_true")
     p.add_argument("--show", action="store_true",
                    help="save eval sample density visualizations")
@@ -80,6 +89,10 @@ def parse_args(argv=None):
     p.add_argument("--pad-multiple", type=int, default=None,
                    help="bucket H,W up to this multiple (default: exact shapes)")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    p.add_argument("--pallas-context", action="store_true",
+                   help="use the fused Pallas TPU kernel for the context "
+                        "block (single-device forward shapes only; "
+                        "incompatible with --sp > 1)")
     p.add_argument("--vgg16-npz", type=str, default="",
                    help="pretrained VGG-16 frontend .npz (tools/convert_vgg16.py)")
     p.add_argument("--eval-interval", type=int, default=1)
@@ -108,8 +121,8 @@ def main(argv=None) -> int:
         print(f"[runtime] {topo}")
         print(f"[start] {datetime.datetime.now():%Y-%m-%d %H:%M:%S}")
     if args.syncBN and main_proc:
-        print("[warn] --syncBN is a no-op: CANNet has no BatchNorm layers "
-              "(same in the reference, SURVEY.md §2)")
+        print("[model] BatchNorm variant; stats sync across replicas via "
+              "global-batch reductions")
 
     mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -135,7 +148,7 @@ def main(argv=None) -> int:
               f"host_batch={host_batch} dp={dp} sp={args.sp}")
 
     # identical init on every host by construction: same seed, same key
-    params = cannet_init(jax.random.key(args.seed))
+    params = cannet_init(jax.random.key(args.seed), batch_norm=args.syncBN)
     if args.vgg16_npz:
         params = load_vgg16_frontend(params, args.vgg16_npz)
         if main_proc:
@@ -146,7 +159,7 @@ def main(argv=None) -> int:
                                 total_steps=args.epochs * steps_per_epoch,
                                 lrf=args.lrf)
     optimizer = make_optimizer(schedule)
-    state = create_train_state(params, optimizer)
+    state = create_train_state(params, optimizer, init_batch_stats(params))
 
     ckpt = CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
@@ -161,6 +174,25 @@ def main(argv=None) -> int:
         elif main_proc:
             print(f"[resume] no checkpoint in {args.init_checkpoint}; cold start")
 
+    if args.syncBN and args.sp > 1:
+        raise SystemExit("--syncBN is not supported with --sp > 1 (the "
+                         "spatial-parallel step does not thread BN stats)")
+    apply_fn = cannet_apply
+    if args.pallas_context:
+        if args.sp > 1:
+            raise SystemExit("--pallas-context is incompatible with --sp > 1")
+        if jax.device_count() > 1:
+            raise SystemExit("--pallas-context is single-device only (the "
+                             "Mosaic custom call has no GSPMD partitioning "
+                             "rule)")
+        from functools import partial
+
+        from can_tpu.models.cannet import LocalOps
+        from can_tpu.ops.pallas_context import make_fused_context
+
+        apply_fn = partial(cannet_apply,
+                           ops=LocalOps(context_fused=make_fused_context()))
+
     if args.sp > 1:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
@@ -169,9 +201,9 @@ def main(argv=None) -> int:
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)
     else:
-        train_step = make_dp_train_step(cannet_apply, optimizer, mesh,
+        train_step = make_dp_train_step(apply_fn, optimizer, mesh,
                                         compute_dtype=compute_dtype)
-    eval_step = make_dp_eval_step(cannet_apply, mesh, compute_dtype=compute_dtype)
+    eval_step = make_dp_eval_step(apply_fn, mesh, compute_dtype=compute_dtype)
     # train batches are H-sharded when sp > 1; eval always data-parallel only
     put_train = lambda b: make_global_batch(b, mesh, spatial=args.sp > 1)
     put = lambda b: make_global_batch(b, mesh)
@@ -196,7 +228,8 @@ def main(argv=None) -> int:
                 if (epoch + 1) % args.eval_interval == 0:
                     metrics = evaluate(eval_step, state.params,
                                        test_batcher.epoch(0), put_fn=put,
-                                       dataset_size=test_batcher.dataset_size)
+                                       dataset_size=test_batcher.dataset_size,
+                                       batch_stats=state.batch_stats)
                     mae = metrics["mae"]
                     lr_now = float(schedule(int(state.step)))
                     logger.log({"train_loss": float(mean_loss), "mae": mae,
@@ -231,10 +264,16 @@ def _save_sample_viz(args, state, test_ds, epoch, logger) -> None:
 
     global _viz_forward
     if _viz_forward is None:
-        _viz_forward = jax.jit(cannet_apply)
+        def _fwd(params, x, batch_stats):
+            if batch_stats is not None:
+                return cannet_apply(params, x, batch_stats=batch_stats,
+                                    train=False)
+            return cannet_apply(params, x)
+
+        _viz_forward = jax.jit(_fwd)
     idx = int(np.random.default_rng((args.seed, epoch)).integers(len(test_ds)))
     img, gt = test_ds[idx]
-    et = _viz_forward(state.params, jnp.asarray(img)[None])
+    et = _viz_forward(state.params, jnp.asarray(img)[None], state.batch_stats)
     out_dir = os.path.join(args.checkpoint_dir, "temp")
     paths = save_density_visualization(img, gt, np.asarray(et)[0], out_dir,
                                        tag=f"epoch{epoch}")

@@ -1,0 +1,42 @@
+"""Async host-side prefetch: overlap data loading/transfer with device work.
+
+The reference gets this from torch DataLoader worker processes
+(reference: train.py:87-91, num_workers); here a single background thread
+runs the (numpy) batch materialisation + host->device transfer while the
+device crunches the previous step — with JAX's async dispatch that is enough
+to hide the input pipeline entirely.
+"""
+
+from __future__ import annotations
+
+import collections
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator
+
+
+def prefetch_to_device(batches: Iterable, put_fn: Callable, *,
+                       depth: int = 2) -> Iterator:
+    """Yield ``put_fn(batch)`` for each batch, computed ``depth`` ahead in a
+    background thread.  depth<=0 disables prefetching."""
+    if depth <= 0:
+        for b in batches:
+            yield put_fn(b)
+        return
+
+    it = iter(batches)
+    _done = object()
+
+    def load_next():
+        try:
+            return put_fn(next(it))
+        except StopIteration:
+            return _done
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        queue = collections.deque(ex.submit(load_next) for _ in range(depth))
+        while queue:
+            result = queue.popleft().result()
+            if result is _done:
+                break
+            queue.append(ex.submit(load_next))
+            yield result

@@ -5,6 +5,8 @@ from .cannet import (
     LocalOps,
     cannet_apply,
     cannet_init,
+    has_batch_norm,
+    init_batch_stats,
     load_vgg16_frontend,
     param_count,
 )
@@ -16,6 +18,8 @@ __all__ = [
     "LocalOps",
     "cannet_apply",
     "cannet_init",
+    "has_batch_norm",
+    "init_batch_stats",
     "load_vgg16_frontend",
     "param_count",
 ]

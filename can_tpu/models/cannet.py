@@ -28,7 +28,7 @@ TPU-first choices (NOT a torch translation):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Mapping, Sequence
+from typing import Any, Callable, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -60,20 +60,35 @@ class LocalOps:
     upsample: Callable = resize_bilinear_align_corners
     # Full (unsharded) feature H, W; None means "use local shape".
     global_hw: Any = None
+    # Optional fused context tail: (fv, [ave_k], [W_k], hw) -> fi
+    # (ops/pallas_context.py provides the TPU kernel).
+    context_fused: Any = None
 
 
-def cannet_init(key: jax.Array, dtype=jnp.float32) -> dict:
+def cannet_init(key: jax.Array, dtype=jnp.float32, *,
+                batch_norm: bool = False) -> dict:
     """Initialise params: conv weights ~ N(0, 0.01), biases 0
     (reference: model/CANNet.py:93-101).  Same key => identical params on
     every host — replaces the reference's rank0-save/barrier/load protocol
     (train.py:104-114) by construction.
+
+    batch_norm=True builds the BN variant of ``make_layers``
+    (reference model/CANNet.py:104-119, its ``batch_norm`` switch): each
+    frontend/backend conv gains a BatchNorm with learnable scale/bias.
+    Running statistics live in a separate tree — see ``init_batch_stats``.
+    Under the GSPMD data-parallel step the batch statistics are computed
+    over the GLOBAL sharded batch, so this IS SyncBatchNorm (the reference's
+    ``--syncBN`` conversion, train.py:116-118) by construction.
     """
 
-    def conv_p(key, kh, kw, cin, cout, bias=True):
+    def conv_p(key, kh, kw, cin, cout, bias=True, bn=False):
         w = jax.random.normal(key, (kh, kw, cin, cout), dtype) * 0.01
         p = {"w": w}
         if bias:
             p["b"] = jnp.zeros((cout,), dtype)
+        if bn:
+            p["bn"] = {"scale": jnp.ones((cout,), dtype),
+                       "bias": jnp.zeros((cout,), dtype)}
         return p
 
     keys = iter(jax.random.split(key, 64))
@@ -82,7 +97,7 @@ def cannet_init(key: jax.Array, dtype=jnp.float32) -> dict:
     for v in FRONTEND_CFG:
         if v == "M":
             continue
-        params["frontend"].append(conv_p(next(keys), 3, 3, cin, v))
+        params["frontend"].append(conv_p(next(keys), 3, 3, cin, v, bn=batch_norm))
         cin = v
     for s in CONTEXT_SCALES:
         params["context"][f"s{s}"] = {
@@ -93,10 +108,31 @@ def cannet_init(key: jax.Array, dtype=jnp.float32) -> dict:
         }
     cin = 2 * _FEAT_CH
     for v in BACKEND_CFG:
-        params["backend"].append(conv_p(next(keys), 3, 3, cin, v))
+        params["backend"].append(conv_p(next(keys), 3, 3, cin, v, bn=batch_norm))
         cin = v
     params["output"] = conv_p(next(keys), 1, 1, BACKEND_CFG[-1], 1)
     return params
+
+
+def has_batch_norm(params: Mapping) -> bool:
+    return "bn" in params["frontend"][0]
+
+
+def init_batch_stats(params: Mapping) -> Optional[dict]:
+    """Running mean/var tree for a BN model (None for the plain model).
+    Mirrors torch BatchNorm2d defaults: mean 0, var 1."""
+    if not has_batch_norm(params):
+        return None
+
+    def stats_for(p):
+        c = p["w"].shape[-1]
+        return {"mean": jnp.zeros((c,), jnp.float32),
+                "var": jnp.ones((c,), jnp.float32)}
+
+    return {
+        "frontend": [stats_for(p) for p in params["frontend"]],
+        "backend": [stats_for(p) for p in params["backend"]],
+    }
 
 
 def cannet_apply(
@@ -106,15 +142,40 @@ def cannet_apply(
     ops: LocalOps = LocalOps(),
     compute_dtype=None,
     precision=None,
-) -> jax.Array:
+    batch_stats: Any = None,
+    train: bool = False,
+    bn_momentum: float = 0.1,
+):
     """Forward pass: NHWC image batch -> (N, H/8, W/8, 1) density map.
 
     Mirrors reference model/CANNet.py:39-91 semantically; structured around
     injected spatial primitives so the same body runs single-device or
     H-sharded (context-parallel) under shard_map.
+
+    For a BN model (cannet_init(batch_norm=True)): pass ``batch_stats``
+    (init_batch_stats) — with ``train=True`` statistics come from the batch
+    and the call returns ``(out, new_batch_stats)``; with ``train=False``
+    the running statistics are used and only ``out`` returns.  Reductions
+    over a GSPMD-sharded batch axis are global, so training-mode BN is
+    cross-replica synchronized (SyncBN) with no extra code.
     """
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
+    bn = has_batch_norm(params)
+    if bn and batch_stats is None and not train:
+        raise ValueError("BN model in eval mode needs batch_stats")
+    new_stats = {"frontend": [], "backend": []} if (bn and train) else None
+
+    def conv_block(x, group, i, dilation):
+        p = params[group][i]
+        y = ops.conv2d(x, p["w"].astype(x.dtype), p["b"].astype(x.dtype),
+                       dilation=dilation, precision=precision)
+        if bn:
+            stats = None if batch_stats is None else batch_stats[group][i]
+            y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum)
+            if new_stats is not None:
+                new_stats[group].append(updated)
+        return jax.nn.relu(y)
 
     # --- VGG-16 frontend ---
     i = 0
@@ -122,43 +183,84 @@ def cannet_apply(
         if v == "M":
             x = ops.max_pool(x)
         else:
-            p = params["frontend"][i]
-            x = conv_relu(x, p, ops, dilation=1, precision=precision)
+            x = conv_block(x, "frontend", i, 1)
             i += 1
     fv = x
 
     # --- multi-scale context block ---
-    hw = ops.global_hw or (fv.shape[-3], fv.shape[-2])
-    num = 0.0
-    den = 0.0
-    for s in CONTEXT_SCALES:
-        cp = params["context"][f"s{s}"]
-        ave = ops.adaptive_pool(fv, s)
-        ave = conv1x1(ave, cp["ave"].astype(ave.dtype), precision=precision)
-        sm = ops.upsample(ave, hw)
-        contrast = sm - fv
-        w = jax.nn.sigmoid(
-            conv1x1(contrast, cp["weight"].astype(fv.dtype), precision=precision)
-        )
-        num = num + w * sm
-        den = den + w
-    fi = num / (den + 1e-12)
+    fi = context_block(params["context"], fv, ops=ops, precision=precision)
     x = jnp.concatenate([fv, fi], axis=-1)
 
     # --- dilated backend ---
-    for p in params["backend"]:
-        x = conv_relu(x, p, ops, dilation=2, precision=precision)
+    for i in range(len(params["backend"])):
+        x = conv_block(x, "backend", i, 2)
     p = params["output"]
     x = ops.conv2d(
         x, p["w"].astype(x.dtype), p["b"].astype(x.dtype), padding=0, precision=precision
     )
+    if new_stats is not None:
+        return x, new_stats
     return x
 
 
-def conv_relu(x, p, ops: LocalOps, *, dilation: int, precision=None):
-    w = p["w"].astype(x.dtype)
-    b = p["b"].astype(x.dtype)
-    return jax.nn.relu(ops.conv2d(x, w, b, dilation=dilation, precision=precision))
+def context_block(cparams: Mapping, fv: jax.Array, *,
+                  ops: LocalOps = LocalOps(), precision=None) -> jax.Array:
+    """Multi-scale context fusion (reference model/CANNet.py:39-84):
+    fi = (sum_k w_k * sm_k) / (sum_k w_k + 1e-12) with
+    sm_k = upsample(1x1(adaptive_pool(fv, k))), w_k = sigmoid(1x1(sm_k - fv)).
+
+    ``ops.context_fused`` (e.g. the Pallas kernel in ops/pallas_context.py)
+    replaces the fusion tail — everything after the per-scale pooled
+    projections — with a single HBM pass; the pooling itself is tiny and
+    stays outside.
+    """
+    hw = ops.global_hw or (fv.shape[-3], fv.shape[-2])
+    aves = []
+    for s in CONTEXT_SCALES:
+        cp = cparams[f"s{s}"]
+        ave = ops.adaptive_pool(fv, s)
+        aves.append(conv1x1(ave, cp["ave"].astype(ave.dtype),
+                            precision=precision))
+    weights = [cparams[f"s{s}"]["weight"].astype(fv.dtype)
+               for s in CONTEXT_SCALES]
+    if ops.context_fused is not None:
+        return ops.context_fused(fv, aves, weights, hw)
+
+    num = 0.0
+    den = 0.0
+    for ave, wmat in zip(aves, weights):
+        sm = ops.upsample(ave, hw)
+        contrast = sm - fv
+        w = jax.nn.sigmoid(conv1x1(contrast, wmat, precision=precision))
+        num = num + w * sm
+        den = den + w
+    return num / (den + 1e-12)
+
+
+def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
+                eps: float = 1e-5):
+    """torch-semantics BatchNorm2d over NHWC: normalize with biased batch
+    var in train mode, update running stats with unbiased var; f32 stats."""
+    yf = y.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(yf, axis=(0, 1, 2))
+        var = jnp.var(yf, axis=(0, 1, 2))  # biased, used for normalization
+        n = int(np.prod([y.shape[0], y.shape[1], y.shape[2]]))
+        unbiased = var * (n / max(n - 1, 1))
+        if stats is not None:
+            updated = {
+                "mean": (1 - momentum) * stats["mean"] + momentum * mean,
+                "var": (1 - momentum) * stats["var"] + momentum * unbiased,
+            }
+        else:
+            updated = {"mean": mean, "var": unbiased}
+    else:
+        mean, var = stats["mean"], stats["var"]
+        updated = None
+    inv = jax.lax.rsqrt(var + eps)
+    out = (yf - mean) * inv * bn_params["scale"].astype(jnp.float32)
+    out = out + bn_params["bias"].astype(jnp.float32)
+    return out.astype(y.dtype), updated
 
 
 def load_vgg16_frontend(params: dict, npz_path: str) -> dict:
@@ -179,7 +281,10 @@ def load_vgg16_frontend(params: dict, npz_path: str) -> dict:
             raise ValueError(f"conv{i}: npz shape {w.shape} != expected {p['w'].shape}")
         if b.shape != p["b"].shape:
             raise ValueError(f"conv{i}: bias shape {b.shape} != expected {p['b'].shape}")
-        frontend.append({"w": w, "b": b})
+        entry = {"w": w, "b": b}
+        if "bn" in p:  # keep the BN params of a BN-variant model
+            entry["bn"] = p["bn"]
+        frontend.append(entry)
     out["frontend"] = frontend
     return out
 

@@ -1,0 +1,193 @@
+"""Pallas TPU kernel: fused multi-scale context tail of CANNet.
+
+The context block (reference model/CANNet.py:39-84) is ~11% of the train
+step (ablation, bench history) and is HBM-bound: the stock XLA lowering
+streams the (B, H, W, 512) feature map and four same-sized intermediates
+(sm, contrast, w, accumulators) through HBM several times.  This kernel
+computes, in ONE pass over ``fv`` tiles resident in VMEM:
+
+    for k in scales:  sm_k   = row-interp(uh_k) . avew_k        (VPU FMAs)
+                      w_k    = sigmoid((sm_k - fv) @ Wk)        (MXU matmul)
+                      num   += w_k * sm_k ;  den += w_k
+    fi = num / (den + 1e-12)
+
+where ``avew_k = ave_k . uw_k^T`` (the width half of the separable
+align-corners upsample, precomputed outside — it is tiny: (B, S, W, C) with
+S <= 6).  Gradients come from a custom VJP that re-differentiates the
+equivalent jnp formulation (recompute-in-backward: residuals are just the
+kernel inputs, no extra HBM).
+
+Constraints (else fall back to the jnp path): feature H divisible by the
+row-tile, feature W a multiple of 16 (bf16 sublane), C = 512.
+
+MEASURED (v5e-1, 576x768 b16 bf16 train step): stock XLA 92.7 img/s, this
+kernel 76.5 img/s.  XLA's automatic fusion of the context block is already
+near-optimal, and the custom-VJP recompute pays the context math twice in
+backward, so the kernel is a net LOSS for training — it is kept as an
+opt-in (--pallas-context / BENCH_PALLAS=1) demonstration and as the
+starting point for an inference-only fused path, NOT the default.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from can_tpu.ops.resize import upsample_matrix
+
+EPS = 1e-12
+ROW_TILE = 8
+
+
+def _precompute(aves, hw):
+    """Width-interpolated pooled maps + row matrices, all f32."""
+    h, w = hw
+    avews, uhs = [], []
+    for ave in aves:
+        s = ave.shape[-3]
+        uw = upsample_matrix(ave.shape[-2], w)  # (W, S)
+        avew = jnp.einsum("bpqc,wq->bpwc", ave.astype(jnp.float32), uw)
+        avews.append(avew)
+        uhs.append(upsample_matrix(s, h))  # (H, S)
+    return avews, uhs
+
+
+def _kernel(fv_ref, *rest):
+    n_scales = (len(rest) - 1) // 3
+    avew_refs = rest[:n_scales]
+    uh_refs = rest[n_scales: 2 * n_scales]
+    w_refs = rest[2 * n_scales: 3 * n_scales]
+    out_ref = rest[-1]
+
+    i = pl.program_id(1)
+    fv = fv_ref[0].astype(jnp.float32)  # (TH, TW, C)
+    th, w, c = fv.shape
+    num = jnp.zeros((th, w, c), jnp.float32)
+    den = jnp.zeros((th, w, c), jnp.float32)
+    for k in range(n_scales):
+        avew = avew_refs[k][0].astype(jnp.float32)     # (S, W, C)
+        uh_tile = uh_refs[k][pl.ds(i * th, th), :]     # (TH, S)
+        s = avew.shape[0]
+        sm = jnp.zeros((th, w, c), jnp.float32)
+        for si in range(s):                            # S <= 6: unrolled FMAs
+            sm = sm + uh_tile[:, si][:, None, None] * avew[si][None]
+        # MXU matmul in the input dtype (bf16 is 8x f32 throughput on v5e),
+        # f32 accumulation
+        mm_dtype = fv_ref.dtype
+        contrast = (sm - fv).astype(mm_dtype).reshape(th * w, c)
+        wmat = w_refs[k][...].astype(mm_dtype)
+        logits = jnp.dot(contrast, wmat,
+                         preferred_element_type=jnp.float32)
+        gate = jax.nn.sigmoid(logits).reshape(th, w, c)
+        num = num + gate * sm
+        den = den + gate
+    out_ref[0] = (num / (den + EPS)).astype(out_ref.dtype)
+
+
+try:  # import guard: pallas TPU lowering is unavailable on some backends
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except ImportError:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+def _pick_col_tile(w: int) -> int:
+    """Largest multiple-of-16 divisor of w that is <= 48 (VMEM budget:
+    ~7 MB/program incl. double buffering at C=512 f32)."""
+    for tw in range(min(w, 48), 0, -16):
+        if w % tw == 0 and tw % 16 == 0:
+            return tw
+    return w
+
+
+def _fused_forward(fv, avews, uhs, weights, *, interpret=False):
+    b, h, w, c = fv.shape
+    tw = _pick_col_tile(w)
+    grid = (b, h // ROW_TILE, w // tw)
+    in_specs = [pl.BlockSpec((1, ROW_TILE, tw, c),
+                             lambda bi, hi, wi: (bi, hi, wi, 0))]
+    for avew in avews:
+        s = avew.shape[1]
+        in_specs.append(pl.BlockSpec((1, s, tw, c),
+                                     lambda bi, hi, wi: (bi, 0, wi, 0)))
+    for uh in uhs:
+        in_specs.append(pl.BlockSpec(uh.shape, lambda bi, hi, wi: (0, 0)))
+    for wmat in weights:
+        in_specs.append(pl.BlockSpec(wmat.shape, lambda bi, hi, wi: (0, 0)))
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, ROW_TILE, tw, c),
+                               lambda bi, hi, wi: (bi, hi, wi, 0)),
+        out_shape=jax.ShapeDtypeStruct(fv.shape, fv.dtype),
+        interpret=interpret,
+    )(fv, *avews, *uhs, *[w.astype(jnp.float32) for w in weights])
+
+
+def _reference(fv, avews, uhs, weights):
+    """jnp twin of the kernel math (used for the VJP and as fallback)."""
+    fvf = fv.astype(jnp.float32)
+    num = 0.0
+    den = 0.0
+    for avew, uh, wmat in zip(avews, uhs, weights):
+        sm = jnp.einsum("hs,bswc->bhwc", uh, avew)
+        contrast = sm - fvf
+        gate = jax.nn.sigmoid(jnp.einsum(
+            "bhwc,cd->bhwd", contrast, wmat.astype(jnp.float32),
+            preferred_element_type=jnp.float32))
+        num = num + gate * sm
+        den = den + gate
+    return (num / (den + EPS)).astype(fv.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(fv, avews, uhs, weights, interpret=False):
+    return _fused_forward(fv, avews, uhs, weights, interpret=interpret)
+
+
+def _fused_fwd(fv, avews, uhs, weights, interpret):
+    out = _fused_forward(fv, avews, uhs, weights, interpret=interpret)
+    return out, (fv, avews, uhs, weights)
+
+
+def _fused_bwd(interpret, residuals, g):
+    fv, avews, uhs, weights = residuals
+    # recompute-in-backward: differentiate the jnp twin (no saved
+    # intermediates, XLA fuses the recompute into the backward)
+    _, vjp = jax.vjp(_reference, fv, avews, uhs, weights)
+    return vjp(g)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def supports(fv_shape) -> bool:
+    if not _PALLAS_OK:
+        return False
+    b, h, w, c = fv_shape
+    return h % ROW_TILE == 0 and w % 16 == 0 and c % 128 == 0
+
+
+def make_fused_context(*, interpret=False):
+    """Returns a LocalOps.context_fused callable: (fv, aves, weights, hw)."""
+
+    def fused(fv, aves: Sequence, weights: Sequence, hw):
+        if tuple(hw) != (fv.shape[-3], fv.shape[-2]):
+            raise ValueError("fused context kernel is single-device only")
+        if not supports(fv.shape):
+            return _fallback(fv, aves, weights, hw)
+        avews, uhs = _precompute(aves, hw)
+        return _fused(fv, tuple(avews), tuple(uhs), tuple(weights), interpret)
+
+    def _fallback(fv, aves, weights, hw):
+        avews, uhs = _precompute(aves, hw)
+        return _reference(fv, tuple(avews), tuple(uhs), tuple(weights))
+
+    return fused

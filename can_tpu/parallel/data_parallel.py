@@ -84,11 +84,13 @@ def make_dp_train_step(apply_fn: Callable, optimizer, mesh: Mesh, *,
 
 def make_dp_eval_step(apply_fn: Callable, mesh: Mesh, *,
                       compute_dtype=None) -> Callable:
-    """Jitted data-parallel ``(params, batch_dict) -> metrics`` (global sums)."""
+    """Jitted data-parallel ``(params, batch_dict[, batch_stats]) -> metrics``
+    (global sums).  ``batch_stats`` (BN running stats, replicated) is only
+    needed for BN models."""
     step = make_eval_step(apply_fn, compute_dtype=compute_dtype)
     repl = NamedSharding(mesh, P())
     return jax.jit(
         step,
-        in_shardings=(repl, _batch_shardings(mesh)),
+        in_shardings=(repl, _batch_shardings(mesh), repl),
         out_shardings=repl,
     )

@@ -38,20 +38,24 @@ def _progress(iterable, *, enabled: bool, desc: str, total: Optional[int]):
 
 def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                     put_fn: Callable, epoch: int = 0, show_progress: bool = True,
-                    check_finite: bool = True, total: Optional[int] = None):
+                    check_finite: bool = True, total: Optional[int] = None,
+                    prefetch: int = 2):
     """Run one epoch; returns (state, mean_per_image_loss).
 
     train_step: jitted (state, batch_dict) -> (state, metrics).
     batches: iterable of data.Batch (this host's slices).
     put_fn: Batch -> device batch dict (parallel.make_global_batch partial).
+    prefetch: batches loaded+transferred ahead in a background thread.
     """
+    from can_tpu.data.prefetch import prefetch_to_device
+
     loss_sum = 0.0
     img_sum = 0.0
     prev = None  # lagged (still-async) metrics for the non-finite check
-    it = _progress(batches, enabled=show_progress, desc=f"epoch {epoch}",
-                   total=total)
-    for batch in it:
-        state, metrics = train_step(state, put_fn(batch))
+    it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch),
+                   enabled=show_progress, desc=f"epoch {epoch}", total=total)
+    for dev_batch in it:
+        state, metrics = train_step(state, dev_batch)
         if prev is not None:
             loss_sum, img_sum = _accumulate(prev, loss_sum, img_sum,
                                             check_finite, epoch)
@@ -77,7 +81,7 @@ def _accumulate(metrics, loss_sum, img_sum, check_finite, epoch):
 
 def evaluate(eval_step: Callable, params, batches: Iterable, *,
              put_fn: Callable, dataset_size: int, show_progress: bool = False,
-             total: Optional[int] = None) -> dict:
+             total: Optional[int] = None, batch_stats=None) -> dict:
     """Dataset MAE and (paper-style) RMSE over the eval set.
 
     eval_step returns global sums (see train/steps.py), so accumulating on
@@ -90,7 +94,7 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
     n_seen = 0.0
     it = _progress(batches, enabled=show_progress, desc="eval", total=total)
     for batch in it:
-        m = jax.device_get(eval_step(params, put_fn(batch)))
+        m = jax.device_get(eval_step(params, put_fn(batch), batch_stats))
         abs_sum += float(m["abs_err_sum"])
         sq_sum += float(m["sq_err_sum"])
         n_seen += float(m["num_valid"])

@@ -20,6 +20,10 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # BN running statistics (None for the plain model); updated by the train
+    # step, consumed by eval — the analogue of torch's buffers, kept out of
+    # the gradient path
+    batch_stats: Any = None
 
 
 def make_lr_schedule(base_lr: float, *, world_size: int = 1,
@@ -42,8 +46,10 @@ def make_optimizer(lr_schedule, *, momentum: float = 0.95,
     return optax.sgd(lr_schedule, momentum=momentum)
 
 
-def create_train_state(params, optimizer: optax.GradientTransformation) -> TrainState:
+def create_train_state(params, optimizer: optax.GradientTransformation,
+                       batch_stats: Any = None) -> TrainState:
     import jax.numpy as jnp
 
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      opt_state=optimizer.init(params))
+                      opt_state=optimizer.init(params),
+                      batch_stats=batch_stats)

@@ -40,17 +40,27 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
     """
 
     def train_step(state, batch):
-        def loss_fn(params):
-            pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype)
-            sse = masked_mse_sum(pred, batch)
-            return sse / grad_divisor, sse
+        has_bn = state.batch_stats is not None
 
-        grads, sse = jax.grad(loss_fn, has_aux=True)(state.params)
+        def loss_fn(params):
+            if has_bn:
+                pred, new_stats = apply_fn(
+                    params, batch["image"], compute_dtype=compute_dtype,
+                    batch_stats=state.batch_stats, train=True)
+            else:
+                pred = apply_fn(params, batch["image"],
+                                compute_dtype=compute_dtype)
+                new_stats = None
+            sse = masked_mse_sum(pred, batch)
+            return sse / grad_divisor, (sse, new_stats)
+
+        grads, (sse, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype),
                               state.params, updates)
-        new_state = state.replace(step=state.step + 1, params=params,
-                                  opt_state=opt_state)
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state,
+            batch_stats=jax.lax.stop_gradient(new_stats) if has_bn else None)
         metrics = {
             "loss": sse,
             "num_valid": jnp.sum(batch["sample_mask"]),
@@ -68,8 +78,12 @@ def make_eval_step(apply_fn: Callable, *, compute_dtype=None) -> Callable:
     the host without shipping density maps back.
     """
 
-    def eval_step(params, batch):
-        pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype)
+    def eval_step(params, batch, batch_stats=None):
+        if batch_stats is not None:
+            pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype,
+                            batch_stats=batch_stats, train=False)
+        else:
+            pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype)
         et, gt = density_counts(pred, batch)
         err = (et - gt) * batch["sample_mask"]
         return {

@@ -1,0 +1,143 @@
+"""BatchNorm variant of CANNet: torch parity + SyncBN-by-construction.
+
+The reference's --syncBN flag is vestigial (its model has no BN layers,
+SURVEY §2); here cannet_init(batch_norm=True) is the real BN variant of
+make_layers (reference model/CANNet.py:104-119) and sharded-batch statistics
+ARE cross-replica statistics under GSPMD.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu.models import (
+    cannet_apply,
+    cannet_init,
+    has_batch_norm,
+    init_batch_stats,
+)
+from can_tpu.models.cannet import _batch_norm
+from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+from can_tpu.data.batching import Batch
+
+
+class TestBatchNormOp:
+    def test_train_mode_matches_torch(self):
+        import torch
+
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=(4, 6, 5, 8)).astype(np.float32)  # NHWC
+        scale = rng.normal(size=(8,)).astype(np.float32)
+        bias = rng.normal(size=(8,)).astype(np.float32)
+        run_mean = rng.normal(size=(8,)).astype(np.float32)
+        run_var = rng.uniform(0.5, 2.0, size=(8,)).astype(np.float32)
+
+        out, updated = _batch_norm(
+            jnp.asarray(y), {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias)},
+            {"mean": jnp.asarray(run_mean), "var": jnp.asarray(run_var)},
+            train=True, momentum=0.1)
+
+        tbn = torch.nn.BatchNorm2d(8, momentum=0.1)
+        with torch.no_grad():
+            tbn.weight.copy_(torch.tensor(scale))
+            tbn.bias.copy_(torch.tensor(bias))
+            tbn.running_mean.copy_(torch.tensor(run_mean))
+            tbn.running_var.copy_(torch.tensor(run_var))
+        tbn.train()
+        t_out = tbn(torch.tensor(y).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+
+        np.testing.assert_allclose(np.asarray(out), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(updated["mean"]),
+                                   tbn.running_mean.numpy(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(updated["var"]),
+                                   tbn.running_var.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_eval_mode_matches_torch(self):
+        import torch
+
+        rng = np.random.default_rng(1)
+        y = rng.normal(size=(2, 4, 4, 5)).astype(np.float32)
+        scale = rng.normal(size=(5,)).astype(np.float32)
+        bias = rng.normal(size=(5,)).astype(np.float32)
+        mean = rng.normal(size=(5,)).astype(np.float32)
+        var = rng.uniform(0.5, 2.0, size=(5,)).astype(np.float32)
+
+        out, updated = _batch_norm(
+            jnp.asarray(y), {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias)},
+            {"mean": jnp.asarray(mean), "var": jnp.asarray(var)},
+            train=False, momentum=0.1)
+        assert updated is None
+
+        tbn = torch.nn.BatchNorm2d(5)
+        with torch.no_grad():
+            tbn.weight.copy_(torch.tensor(scale))
+            tbn.bias.copy_(torch.tensor(bias))
+            tbn.running_mean.copy_(torch.tensor(mean))
+            tbn.running_var.copy_(torch.tensor(var))
+        tbn.eval()
+        t_out = tbn(torch.tensor(y).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(out), t_out.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestBNModel:
+    def test_plain_model_has_no_bn(self):
+        params = cannet_init(jax.random.key(0))
+        assert not has_batch_norm(params)
+        assert init_batch_stats(params) is None
+
+    def test_bn_forward_and_stats_update(self):
+        params = cannet_init(jax.random.key(0), batch_norm=True)
+        assert has_batch_norm(params)
+        stats = init_batch_stats(params)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 64, 64, 3)).astype(np.float32))
+        out, new_stats = cannet_apply(params, x, batch_stats=stats, train=True)
+        assert out.shape == (2, 8, 8, 1)
+        # stats moved away from the init values
+        assert not np.allclose(np.asarray(new_stats["frontend"][0]["mean"]),
+                               np.asarray(stats["frontend"][0]["mean"]))
+        # eval mode consumes stats, single return
+        out2 = cannet_apply(params, x, batch_stats=new_stats, train=False)
+        assert out2.shape == (2, 8, 8, 1)
+        assert np.isfinite(np.asarray(out2)).all()
+
+    def test_eval_without_stats_raises(self):
+        params = cannet_init(jax.random.key(0), batch_norm=True)
+        with pytest.raises(ValueError, match="batch_stats"):
+            cannet_apply(params, jnp.ones((1, 64, 64, 3)), train=False)
+
+
+class TestSyncBN:
+    def test_sharded_train_step_is_syncbn(self):
+        """BN stats from the dp=8-sharded batch equal full-batch stats: the
+        sharded model IS SyncBatchNorm."""
+        mesh = make_mesh(jax.devices()[:8])
+        params = cannet_init(jax.random.key(0), batch_norm=True)
+        opt = make_optimizer(make_lr_schedule(1e-8, world_size=8))
+        rng = np.random.default_rng(0)
+        b = 8
+        batch = Batch(
+            image=rng.normal(size=(b, 64, 64, 3)).astype(np.float32),
+            dmap=rng.uniform(size=(b, 8, 8, 1)).astype(np.float32),
+            pixel_mask=np.ones((b, 8, 8, 1), np.float32),
+            sample_mask=np.ones((b,), np.float32),
+        )
+        step = make_dp_train_step(cannet_apply, opt, mesh, donate=False)
+        state = create_train_state(params, opt, init_batch_stats(params))
+        state2, _ = step(state, make_global_batch(batch, mesh))
+
+        # reference: unsharded forward over the SAME full batch
+        _, want = cannet_apply(params, jnp.asarray(batch.image),
+                               batch_stats=init_batch_stats(params), train=True)
+        got = state2.batch_stats
+        np.testing.assert_allclose(
+            np.asarray(got["frontend"][0]["mean"]),
+            np.asarray(want["frontend"][0]["mean"]), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(got["backend"][-1]["var"]),
+            np.asarray(want["backend"][-1]["var"]), rtol=1e-3, atol=1e-6)

@@ -177,3 +177,24 @@ class TestShardedBatcher:
         for pm in (None, 64):
             b = ShardedBatcher(ds, 3, shuffle=False, pad_multiple=pm)
             assert b.batches_per_epoch(0) == len(list(b.epoch(0)))
+
+
+class TestPrefetch:
+    def test_order_and_completeness(self):
+        from can_tpu.data import prefetch_to_device
+
+        seen = []
+        out = list(prefetch_to_device(range(7), lambda x: (seen.append(x), x * 2)[1],
+                                      depth=3))
+        assert out == [0, 2, 4, 6, 8, 10, 12]
+        assert seen == list(range(7))
+
+    def test_depth_zero_is_sync(self):
+        from can_tpu.data import prefetch_to_device
+
+        assert list(prefetch_to_device([1, 2], lambda x: x, depth=0)) == [1, 2]
+
+    def test_empty(self):
+        from can_tpu.data import prefetch_to_device
+
+        assert list(prefetch_to_device([], lambda x: x)) == []

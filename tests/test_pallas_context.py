@@ -1,0 +1,69 @@
+"""Fused context-block Pallas kernel: parity in interpret mode on CPU.
+
+The compiled TPU path is exercised by bench.py (BENCH_PALLAS=1); these tests
+pin the kernel math (forward + custom VJP) against the stock jnp context
+block at float tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu.models import cannet_init
+from can_tpu.models.cannet import LocalOps, context_block
+from can_tpu.ops.pallas_context import ROW_TILE, make_fused_context, supports
+
+
+@pytest.fixture(scope="module")
+def cparams():
+    return cannet_init(jax.random.key(0))["context"]
+
+
+def _fv(b=2, h=16, w=32, c=512, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(
+        size=(b, h, w, c)).astype(np.float32))
+
+
+class TestFusedContext:
+    def test_forward_parity(self, cparams):
+        fv = _fv()
+        ref = context_block(cparams, fv)
+        ops = LocalOps(context_fused=make_fused_context(interpret=True))
+        got = context_block(cparams, fv, ops=ops)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_parity(self, cparams):
+        fv = _fv(b=1, h=8, w=16)
+        ops = LocalOps(context_fused=make_fused_context(interpret=True))
+
+        def loss(fn_ops, x):
+            return jnp.sum(context_block(cparams, x, ops=fn_ops) ** 2)
+
+        g_ref = jax.grad(lambda x: loss(LocalOps(), x))(fv)
+        g_pl = jax.grad(lambda x: loss(ops, x))(fv)
+        np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_unsupported_shape_falls_back(self, cparams):
+        # W=20 not a multiple of 16: must route to the jnp fallback and
+        # still be correct
+        fv = _fv(b=1, h=ROW_TILE, w=20)
+        assert not supports(fv.shape)
+        ops = LocalOps(context_fused=make_fused_context(interpret=True))
+        got = context_block(cparams, fv, ops=ops)
+        ref = context_block(cparams, fv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bf16_input(self, cparams):
+        fv = _fv().astype(jnp.bfloat16)
+        ops = LocalOps(context_fused=make_fused_context(interpret=True))
+        got = context_block(cparams, fv, ops=ops)
+        ref = context_block(cparams, fv)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=1e-2)

@@ -68,3 +68,24 @@ def test_bad_shapes_rejected(tmp_path):
     np.savez(p, **bad_b)
     with pytest.raises(ValueError, match="conv2.*bias"):
         load_vgg16_frontend(params, str(p))
+
+
+def test_bn_params_survive_vgg_load(tmp_path):
+    """--syncBN + --vgg16-npz: loading pretrained conv weights must keep the
+    BatchNorm params (and so has_batch_norm stays True)."""
+    import jax as _jax
+
+    from can_tpu.models import has_batch_norm, init_batch_stats
+
+    sd = synthetic_vgg16_state_dict()
+    npz = tmp_path / "w.npz"
+    np.savez(npz, **state_dict_to_npz_arrays(sd))
+
+    params = cannet_init(_jax.random.key(0), batch_norm=True)
+    loaded = load_vgg16_frontend(params, str(npz))
+    assert has_batch_norm(loaded)
+    assert init_batch_stats(loaded) is not None
+    for p_old, p_new in zip(params["frontend"], loaded["frontend"]):
+        assert "bn" in p_new
+        np.testing.assert_array_equal(np.asarray(p_new["bn"]["scale"]),
+                                      np.asarray(p_old["bn"]["scale"]))

@@ -1,0 +1,49 @@
+"""Offline ground-truth density-map generation CLI.
+
+The reference's data_preparation/k_nearest_gaussian_kernel.py __main__ block
+(:58-83) with its hardcoded Windows path replaced by a flag, its 1-point
+crash fixed, and the O(people x H x W) per-point full-image filtering
+replaced by exact windowed stamping (see can_tpu/data/density.py).
+
+Usage:
+    python tools/prepare_data.py --root data/part_A            # train+test
+    python tools/prepare_data.py --dirs data/part_A/train_data/images
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=None,
+                    help="dataset root containing {train,test}_data/images")
+    ap.add_argument("--dirs", nargs="*", default=None,
+                    help="explicit image directories")
+    ap.add_argument("--k", type=int, default=3, help="nearest neighbours")
+    ap.add_argument("--sigma-scale", type=float, default=0.1)
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args()
+
+    from can_tpu.data import generate_density_maps
+
+    dirs = list(args.dirs or [])
+    if args.root:
+        for split in ("train", "test"):
+            d = os.path.join(args.root, f"{split}_data", "images")
+            if os.path.isdir(d):
+                dirs.append(d)
+    if not dirs:
+        raise SystemExit("no image directories given (use --root or --dirs)")
+    n = generate_density_maps(dirs, k=args.k, sigma_scale=args.sigma_scale,
+                              verbose=not args.quiet)
+    print(f"wrote {n} density maps")
+
+
+if __name__ == "__main__":
+    main()
