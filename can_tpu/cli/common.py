@@ -45,6 +45,23 @@ def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:
     return mesh, global_batch // nproc, dp
 
 
+def make_inference_forward():
+    """Jitted single-image forward that handles both model variants:
+    ``fwd(params, image, batch_stats_or_None)`` (shared by the train CLI's
+    --show visualization and the test CLI's --show-index)."""
+    import jax as _jax
+
+    from can_tpu.models import cannet_apply
+
+    def _fwd(params, x, batch_stats):
+        if batch_stats is not None:
+            return cannet_apply(params, x, batch_stats=batch_stats,
+                                train=False)
+        return cannet_apply(params, x)
+
+    return _jax.jit(_fwd)
+
+
 class SpatialStepCache:
     """Per-image-shape cache of spatial train steps (each H x W bucket shape
     compiles its own shard_map program, mirroring jit's per-shape cache)."""

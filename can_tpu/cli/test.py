@@ -97,13 +97,11 @@ def main(argv=None) -> int:
           f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
     if args.show_index is not None:
+        from can_tpu.cli.common import make_inference_forward
+
         img, gt = ds[args.show_index]
-        if batch_stats is not None:
-            et = jax.jit(lambda p, x, bs: cannet_apply(
-                p, x, batch_stats=bs, train=False))(
-                    params, jnp.asarray(img)[None], batch_stats)
-        else:
-            et = jax.jit(cannet_apply)(params, jnp.asarray(img)[None])
+        et = make_inference_forward()(params, jnp.asarray(img)[None],
+                                      batch_stats)
         paths = save_density_visualization(
             img, gt, np.asarray(et)[0], args.out_dir,
             tag=f"{args.split}_{args.show_index}")

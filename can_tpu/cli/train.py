@@ -114,8 +114,18 @@ def apply_platform(args) -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # fail fast on incompatible flag combinations (before any expensive
+    # model/optimizer/checkpoint work)
+    if args.syncBN and args.sp > 1:
+        raise SystemExit("--syncBN is not supported with --sp > 1 (the "
+                         "spatial-parallel step does not thread BN stats)")
+    if args.pallas_context and args.sp > 1:
+        raise SystemExit("--pallas-context is incompatible with --sp > 1")
     apply_platform(args)
     topo = init_runtime()
+    if args.pallas_context and jax.device_count() > 1:
+        raise SystemExit("--pallas-context is single-device only (the "
+                         "Mosaic custom call has no GSPMD partitioning rule)")
     main_proc = is_main_process()
     if main_proc:
         print(f"[runtime] {topo}")
@@ -174,17 +184,8 @@ def main(argv=None) -> int:
         elif main_proc:
             print(f"[resume] no checkpoint in {args.init_checkpoint}; cold start")
 
-    if args.syncBN and args.sp > 1:
-        raise SystemExit("--syncBN is not supported with --sp > 1 (the "
-                         "spatial-parallel step does not thread BN stats)")
     apply_fn = cannet_apply
     if args.pallas_context:
-        if args.sp > 1:
-            raise SystemExit("--pallas-context is incompatible with --sp > 1")
-        if jax.device_count() > 1:
-            raise SystemExit("--pallas-context is single-device only (the "
-                             "Mosaic custom call has no GSPMD partitioning "
-                             "rule)")
         from functools import partial
 
         from can_tpu.models.cannet import LocalOps
@@ -264,13 +265,9 @@ def _save_sample_viz(args, state, test_ds, epoch, logger) -> None:
 
     global _viz_forward
     if _viz_forward is None:
-        def _fwd(params, x, batch_stats):
-            if batch_stats is not None:
-                return cannet_apply(params, x, batch_stats=batch_stats,
-                                    train=False)
-            return cannet_apply(params, x)
+        from can_tpu.cli.common import make_inference_forward
 
-        _viz_forward = jax.jit(_fwd)
+        _viz_forward = make_inference_forward()
     idx = int(np.random.default_rng((args.seed, epoch)).integers(len(test_ds)))
     img, gt = test_ds[idx]
     et = _viz_forward(state.params, jnp.asarray(img)[None], state.batch_stats)

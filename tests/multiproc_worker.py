@@ -1,0 +1,68 @@
+"""Worker for the real two-process distributed test (test_multiprocess.py).
+
+Each process owns 4 virtual CPU devices (global mesh: 8). Runs 2 steps of
+data-parallel CANNet training through the REAL multi-host path —
+jax.distributed rendezvous, lockstep ShardedBatcher,
+make_array_from_process_local_data — and writes the final loss to a file.
+
+Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    rank, nprocs, port, out_dir = (int(sys.argv[1]), int(sys.argv[2]),
+                                   sys.argv[3], sys.argv[4])
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    from can_tpu.parallel import (
+        init_runtime,
+        make_dp_train_step,
+        make_global_batch,
+        make_mesh,
+        shutdown_runtime,
+    )
+    from can_tpu.data import CrowdDataset, ShardedBatcher
+    from can_tpu.models import cannet_apply, cannet_init
+    from can_tpu.train import (
+        create_train_state,
+        make_lr_schedule,
+        make_optimizer,
+        train_one_epoch,
+    )
+
+    topo = init_runtime(coordinator_address=f"localhost:{port}",
+                        num_processes=nprocs, process_id=rank)
+    assert topo["process_count"] == nprocs, topo
+    assert topo["global_devices"] == 4 * nprocs, topo
+
+    ds = CrowdDataset(os.path.join(out_dir, "data", "images"),
+                      os.path.join(out_dir, "data", "ground_truth"),
+                      gt_downsample=8, phase="train")
+    mesh = make_mesh()
+    batcher = ShardedBatcher(ds, 4, shuffle=True, seed=3,
+                             process_index=rank, process_count=nprocs)
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh)
+    state, mean_loss = train_one_epoch(
+        step, state, batcher.epoch(0),
+        put_fn=lambda b: make_global_batch(b, mesh),
+        show_progress=False)
+
+    with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
+        f.write(f"{mean_loss:.10g}\n")
+    shutdown_runtime()
+
+
+if __name__ == "__main__":
+    main()
