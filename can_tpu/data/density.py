@@ -63,9 +63,40 @@ def _stamp_gaussian(density: np.ndarray, row: int, col: int, sigma: float,
     density[r0:r1, c0:c1] += np.outer(kr, kc)
 
 
+_native_lib = None
+_native_checked = False
+
+
+def _load_native():
+    """ctypes handle to the C++ stamping loop (tools/build_native.py), or
+    None — everything works without it, just slower on dense annotations."""
+    global _native_lib, _native_checked
+    if _native_checked:
+        return _native_lib
+    _native_checked = True
+    import ctypes
+    import os
+
+    so = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "native", "libdensity_stamp.so")
+    if os.path.exists(so):
+        try:
+            lib = ctypes.CDLL(so)
+            d = ctypes.POINTER(ctypes.c_double)
+            lib.stamp_gaussians.argtypes = [d, ctypes.c_int64, ctypes.c_int64,
+                                            d, d, d, ctypes.c_int64,
+                                            ctypes.c_double]
+            lib.stamp_gaussians.restype = None
+            _native_lib = lib
+        except OSError:
+            _native_lib = None
+    return _native_lib
+
+
 def gaussian_density_map(points: np.ndarray, shape: Sequence[int], *,
                          k: int = 3, sigma_scale: float = 0.1,
-                         truncate: float = 4.0) -> np.ndarray:
+                         truncate: float = 4.0,
+                         use_native: bool = True) -> np.ndarray:
     """Geometry-adaptive Gaussian density map.
 
     points: (P, 2) array of ``(col, row)`` head positions (the ShanghaiTech
@@ -73,6 +104,10 @@ def gaussian_density_map(points: np.ndarray, shape: Sequence[int], *,
     shape: (H, W) of the image.
     Returns float32 (H, W) density map with sum ~= number of in-bounds heads
     (minus mass clipped at borders).
+
+    The stamping loop runs in the C++ library (can_tpu/native/) when built;
+    ``use_native=False`` or a missing .so falls back to numpy — identical
+    output either way (tested).
     """
     h, w = int(shape[0]), int(shape[1])
     density = np.zeros((h, w), dtype=np.float64)
@@ -87,6 +122,7 @@ def gaussian_density_map(points: np.ndarray, shape: Sequence[int], *,
         distances, _ = tree.query(points, k=min(k + 1, n))
         distances = np.atleast_2d(distances)
 
+    rows, cols, sigmas = [], [], []
     for i, (c, r) in enumerate(points):
         row, col = int(r), int(c)
         if not (0 <= row < h and 0 <= col < w):
@@ -98,17 +134,83 @@ def gaussian_density_map(points: np.ndarray, shape: Sequence[int], *,
             sigma = (h + w) / 2.0 / 4.0  # fixed 1-point fallback (bug fix)
         if sigma <= 0:
             sigma = 1.0  # coincident points would give sigma 0
-        _stamp_gaussian(density, row, col, sigma, truncate)
+        rows.append(row)
+        cols.append(col)
+        sigmas.append(sigma)
+
+    lib = _load_native() if use_native else None
+    if lib is not None and rows:
+        import ctypes
+
+        ra = np.asarray(rows, np.float64)
+        ca = np.asarray(cols, np.float64)
+        sa = np.asarray(sigmas, np.float64)
+        dptr = ctypes.POINTER(ctypes.c_double)
+        lib.stamp_gaussians(
+            density.ctypes.data_as(dptr), h, w,
+            ra.ctypes.data_as(dptr), ca.ctypes.data_as(dptr),
+            sa.ctypes.data_as(dptr), len(ra), float(truncate))
+    else:
+        for row, col, sigma in zip(rows, cols, sigmas):
+            _stamp_gaussian(density, row, col, sigma, truncate)
     return density.astype(np.float32)
 
 
 def _load_mat_points(mat_path: str) -> np.ndarray:
     """Extract (col,row) head annotations from a ShanghaiTech-style .mat
-    (layout per reference k_nearest_gaussian_kernel.py:79)."""
+    (layout per reference k_nearest_gaussian_kernel.py:79), tolerating the
+    nesting variants different MATLAB exporters produce."""
     import scipy.io as sio
 
     mat = sio.loadmat(mat_path)
-    return np.asarray(mat["image_info"][0, 0][0, 0][0], dtype=np.float64)
+    try:
+        pts = np.asarray(mat["image_info"][0, 0][0, 0][0], dtype=np.float64)
+        if pts.ndim == 2 and pts.shape[1] == 2:
+            return pts
+    except (KeyError, IndexError, TypeError, ValueError):
+        pass
+    # fallback: an (N, 2) numeric array under a recognised annotation key /
+    # struct field only — an unconstrained search could silently pick up a
+    # [W, H] size pair or bbox corners as "heads"
+    for key in _ANNOTATION_KEYS:
+        if key in mat:
+            found = _find_points(mat[key])
+            if found is not None:
+                return found
+    found = _find_points(mat.get("image_info"))
+    if found is None:
+        raise ValueError(
+            f"no (N, 2) annotation array found in {mat_path} under keys "
+            f"{sorted(k for k in mat if not k.startswith('__'))}")
+    return found
+
+
+_ANNOTATION_KEYS = ("annPoints", "points", "location", "locations")
+
+
+def _find_points(obj):
+    if isinstance(obj, np.ndarray):
+        if obj.ndim >= 2 and obj.shape[-1] == 2 and obj.size > 0 and \
+                np.issubdtype(obj.dtype, np.number):
+            return np.asarray(obj, dtype=np.float64).reshape(-1, 2)
+        if obj.dtype == object or obj.dtype.names:
+            items = obj.flat
+            for item in items:
+                if obj.dtype.names:
+                    for name in obj.dtype.names:
+                        got = _find_points(item[name])
+                        if got is not None:
+                            return got
+                else:
+                    got = _find_points(item)
+                    if got is not None:
+                        return got
+    elif isinstance(obj, (list, tuple)):
+        for item in obj:
+            got = _find_points(item)
+            if got is not None:
+                return got
+    return None
 
 
 def generate_density_maps(image_dirs: Sequence[str], *, k: int = 3,

@@ -1,0 +1,67 @@
+// Native hot loop of the ground-truth density-map generator.
+//
+// The reference generator spends its time convolving one delta per person
+// with a full-image Gaussian (reference:
+// data_preparation/k_nearest_gaussian_kernel.py:42-52, O(people x H x W)).
+// can_tpu/data/density.py already reduces that to exact windowed stamping;
+// this file is the same stamping loop in C++ (dense Gaussian outer products
+// over clipped windows), ~10x the numpy version on annotation-dense images
+// and independent of Python object overhead.
+//
+// Exposed C ABI (consumed via ctypes, see can_tpu/data/density.py):
+//   stamp_gaussians(density, h, w, rows, cols, sigmas, n, truncate)
+//     density: float64[h*w], row-major, accumulated in place
+//     rows/cols: float64[n] pixel coordinates (already validated in-bounds)
+//     sigmas: float64[n] per-point Gaussian sigma
+//
+// Build: tools/build_native.py (g++ -O3 -shared -fPIC).
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+void stamp_gaussians(double *density, int64_t h, int64_t w,
+                     const double *rows, const double *cols,
+                     const double *sigmas, int64_t n, double truncate) {
+  std::vector<double> kr, kc;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t row = static_cast<int64_t>(rows[i]);
+    const int64_t col = static_cast<int64_t>(cols[i]);
+    const double sigma = sigmas[i];
+    const int64_t radius = static_cast<int64_t>(truncate * sigma + 0.5);
+    if (radius < 1) {
+      density[row * w + col] += 1.0;
+      continue;
+    }
+    // sampled 1-D Gaussian, normalised to sum 1 over the full support
+    // (scipy.ndimage semantics; clipping at image borders loses mass,
+    // matching mode='constant')
+    const int64_t klen = 2 * radius + 1;
+    kr.assign(klen, 0.0);
+    double sum = 0.0;
+    for (int64_t t = 0; t < klen; ++t) {
+      const double x = static_cast<double>(t - radius) / sigma;
+      kr[t] = std::exp(-0.5 * x * x);
+      sum += kr[t];
+    }
+    for (int64_t t = 0; t < klen; ++t) kr[t] /= sum;
+    kc = kr;  // isotropic
+
+    const int64_t r0 = row - radius < 0 ? 0 : row - radius;
+    const int64_t r1 = row + radius + 1 > h ? h : row + radius + 1;
+    const int64_t c0 = col - radius < 0 ? 0 : col - radius;
+    const int64_t c1 = col + radius + 1 > w ? w : col + radius + 1;
+    for (int64_t r = r0; r < r1; ++r) {
+      const double krv = kr[r - (row - radius)];
+      double *drow = density + r * w;
+      const double *kcp = kc.data() + (c0 - (col - radius));
+      for (int64_t c = c0; c < c1; ++c) {
+        drow[c] += krv * kcp[c - c0];
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -64,7 +64,8 @@ def dp_size(mesh: Mesh) -> int:
 
 
 def make_dp_train_step(apply_fn: Callable, optimizer, mesh: Mesh, *,
-                       compute_dtype=None, donate: bool = True) -> Callable:
+                       compute_dtype=None, donate: bool = True,
+                       remat: bool = False) -> Callable:
     """Jitted data-parallel ``(state, batch_dict) -> (state, metrics)``.
 
     state is replicated, batch sharded on ``data``; the state buffers are
@@ -72,7 +73,7 @@ def make_dp_train_step(apply_fn: Callable, optimizer, mesh: Mesh, *,
     separate grad buffers).
     """
     step = make_train_step(apply_fn, optimizer, grad_divisor=dp_size(mesh),
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype, remat=remat)
     repl = NamedSharding(mesh, P())
     return jax.jit(
         step,

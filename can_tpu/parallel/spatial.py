@@ -223,3 +223,54 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
         out_shardings=(repl, repl),
         donate_argnums=(0,) if donate else (),
     )
+
+
+def make_sp_eval_step(mesh: Mesh, image_hw: Tuple[int, int], *,
+                      compute_dtype=None) -> Callable:
+    """Jitted dp x sp eval step: ``(params, batch_dict) -> metrics``.
+
+    The spatial twin of parallel.make_dp_eval_step — needed when one image is
+    too large for a single chip (the UCF-QNRF config).  Per-image counts are
+    partial per H-shard; psum over ``spatial`` completes them BEFORE the
+    |et - gt| (the absolute value does not commute with the shard sum), then
+    metric sums psum over ``data``.
+    """
+    sp = mesh.shape[SPATIAL_AXIS]
+    h, w = image_hw
+    _check_spatial_shapes(h, sp)
+    ops = make_spatial_ops(SPATIAL_AXIS, sp, (h // 8, w // 8))
+
+    bspec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
+    batch_specs = {"image": bspec, "dmap": bspec, "pixel_mask": bspec,
+                   "sample_mask": P(DATA_AXIS)}
+
+    def body(params, batch):
+        pred = cannet_apply(params, batch["image"], ops=ops,
+                            compute_dtype=compute_dtype)
+        mask = batch["pixel_mask"] * batch["sample_mask"][:, None, None, None]
+        et_part = jnp.sum(pred.astype(jnp.float32) * mask, axis=(1, 2, 3))
+        gt_part = jnp.sum(batch["dmap"] * mask, axis=(1, 2, 3))
+        et = lax.psum(et_part, SPATIAL_AXIS)
+        gt = lax.psum(gt_part, SPATIAL_AXIS)
+        err = (et - gt) * batch["sample_mask"]
+        return {
+            "abs_err_sum": lax.psum(jnp.sum(jnp.abs(err)), DATA_AXIS),
+            "sq_err_sum": lax.psum(jnp.sum(err * err), DATA_AXIS),
+            "num_valid": lax.psum(jnp.sum(batch["sample_mask"]), DATA_AXIS),
+        }
+
+    repl = NamedSharding(mesh, P())
+    batch_shardings = {k: NamedSharding(mesh, v) for k, v in batch_specs.items()}
+    sharded = shard_map(body, mesh=mesh, in_specs=(P(), batch_specs),
+                        out_specs=P(), check_vma=False)
+
+    # evaluate() calls eval_step(params, batch, batch_stats); BN is not
+    # supported under sp, so accept-and-reject the third argument
+    def step(params, batch, batch_stats=None):
+        if batch_stats is not None:
+            raise ValueError("BN models are not supported under spatial "
+                             "parallelism")
+        return sharded(params, batch)
+
+    return jax.jit(step, in_shardings=(repl, batch_shardings, repl),
+                   out_shardings=repl)

@@ -198,3 +198,52 @@ class TestPrefetch:
         from can_tpu.data import prefetch_to_device
 
         assert list(prefetch_to_device([], lambda x: x)) == []
+
+
+class TestNativeStamping:
+    def test_native_matches_numpy(self):
+        import pytest as _pytest
+
+        from can_tpu.data.density import _load_native
+
+        if _load_native() is None:
+            _pytest.skip("native library not built (tools/build_native.py)")
+        rng = np.random.default_rng(4)
+        h, w = 150, 200
+        points = np.stack([rng.uniform(-5, w + 5, 120),
+                           rng.uniform(-5, h + 5, 120)], axis=1)
+        native = gaussian_density_map(points, (h, w), use_native=True)
+        python = gaussian_density_map(points, (h, w), use_native=False)
+        np.testing.assert_allclose(native, python, atol=1e-6)
+        assert native.sum() > 0
+
+
+class TestMatPipeline:
+    def test_generate_density_maps_from_mat(self, tmp_path):
+        """Offline driver: images + ShanghaiTech-style .mat -> .npy maps
+        (reference k_nearest_gaussian_kernel.py:58-83)."""
+        import scipy.io as sio
+        from PIL import Image
+
+        from can_tpu.data import generate_density_maps
+
+        root = tmp_path / "train_data"
+        (root / "images").mkdir(parents=True)
+        (root / "ground_truth").mkdir()
+        rng = np.random.default_rng(0)
+        h, w = 100, 140
+        Image.fromarray((rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8)
+                        ).save(root / "images" / "IMG_7.jpg")
+        pts = np.stack([rng.uniform(20, w - 20, 12),
+                        rng.uniform(20, h - 20, 12)], axis=1)
+        inner = np.empty((1, 1), object)
+        inner[0, 0] = (pts,)
+        sio.savemat(root / "ground_truth" / "GT_IMG_7.mat",
+                    {"image_info": inner})
+
+        n = generate_density_maps([str(root / "images")], verbose=False)
+        assert n == 1
+        d = np.load(root / "ground_truth" / "IMG_7.npy")
+        assert d.shape == (h, w)
+        # interior points: count conserved
+        assert abs(d.sum() - 12) < 0.1

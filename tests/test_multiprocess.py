@@ -35,18 +35,31 @@ def test_two_process_training_agrees(tmp_path):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    # stdout goes to files, not pipes: a worker blocked on a full stdout
+    # pipe mid-collective would deadlock its peer at the rendezvous
+    logs = [open(tmp_path / f"worker_{rank}.log", "wb") for rank in range(2)]
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(os.path.dirname(__file__),
                                           "multiproc_worker.py"),
              str(rank), "2", str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, stdout=logs[rank], stderr=subprocess.STDOUT,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         for rank in range(2)
     ]
-    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    try:
+        for p in procs:
+            p.wait(timeout=600)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in logs:
+            f.close()
+    for rank, p in enumerate(procs):
+        out = (tmp_path / f"worker_{rank}.log").read_bytes().decode()
+        assert p.returncode == 0, f"worker {rank} failed:\n{out[-3000:]}"
 
     losses = [float(open(tmp_path / f"loss_{r}.txt").read()) for r in range(2)]
     # the loss is a replicated global value: both processes must agree

@@ -118,3 +118,35 @@ class TestSpatialTrainStep:
             assert np.abs(da - db).max() <= max(2e-3 * scale, 3e-8)
 
         jax.tree.map(close, params, s_sp.params, s_1.params)
+
+
+class TestSpatialEval:
+    def test_sp_eval_matches_dp_eval(self, params):
+        """dp x sp eval metrics == plain dp eval on the same batch."""
+        from can_tpu.parallel import make_dp_eval_step, make_global_batch
+        from can_tpu.parallel.spatial import make_sp_eval_step
+        from can_tpu.data.batching import Batch
+
+        mesh_sp = make_mesh(jax.devices()[:8], dp=2, sp=4)
+        mesh_dp = make_mesh(jax.devices()[:8])
+        h, w = 128, 96
+        rng = np.random.default_rng(9)
+        batch = Batch(
+            image=rng.normal(size=(8, h, w, 3)).astype(np.float32),
+            dmap=rng.uniform(size=(8, h // 8, w // 8, 1)).astype(np.float32),
+            pixel_mask=np.ones((8, h // 8, w // 8, 1), np.float32),
+            sample_mask=np.asarray([1, 1, 1, 1, 1, 1, 0, 0], np.float32),
+        )
+        ev_sp = make_sp_eval_step(mesh_sp, (h, w))
+        m_sp = jax.device_get(ev_sp(params,
+                                    make_global_batch(batch, mesh_sp, spatial=True),
+                                    None))
+
+        ev_dp = make_dp_eval_step(cannet_apply, mesh_dp)
+        m_dp = jax.device_get(ev_dp(params, make_global_batch(batch, mesh_dp),
+                                    None))
+        assert m_sp["num_valid"] == m_dp["num_valid"] == 6.0
+        np.testing.assert_allclose(m_sp["abs_err_sum"], m_dp["abs_err_sum"],
+                                   rtol=2e-4)
+        np.testing.assert_allclose(m_sp["sq_err_sum"], m_dp["sq_err_sum"],
+                                   rtol=4e-4)

@@ -209,3 +209,24 @@ class TestLoops:
             evaluate(ev, params, [random_batch(np.random.default_rng(0))],
                      put_fn=lambda b: make_global_batch(b, mesh8),
                      dataset_size=999)
+
+
+class TestRemat:
+    def test_remat_matches_plain(self, mesh8):
+        """jax.checkpoint changes memory, not math."""
+        params = tiny_init(jax.random.key(7))
+        opt = make_optimizer(make_lr_schedule(1e-8))
+        batch = random_batch(np.random.default_rng(2))
+        db = {k: jnp.asarray(getattr(batch, k))
+              for k in ("image", "dmap", "pixel_mask", "sample_mask")}
+        s_a = create_train_state(jax.tree.map(jnp.array, params), opt)
+        s_b = create_train_state(jax.tree.map(jnp.array, params), opt)
+        step_plain = jax.jit(make_train_step(tiny_apply, opt))
+        step_remat = jax.jit(make_train_step(tiny_apply, opt, remat=True))
+        s_a, m_a = step_plain(s_a, db)
+        s_b, m_b = step_remat(s_b, db)
+        np.testing.assert_allclose(float(m_a["loss"]), float(m_b["loss"]),
+                                   rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-8),
+            s_a.params, s_b.params)

@@ -1,0 +1,30 @@
+"""Build the native density-stamping library (can_tpu/native/).
+
+Usage: python tools/build_native.py
+Produces can_tpu/native/libdensity_stamp.so; can_tpu/data/density.py picks it
+up automatically (and falls back to numpy when absent).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def build(verbose: bool = True) -> str:
+    native = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "can_tpu", "native")
+    src = os.path.join(native, "density_stamp.cpp")
+    out = os.path.join(native, "libdensity_stamp.so")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", out, src]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.exit(0)
