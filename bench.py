@@ -16,8 +16,9 @@ scale), bf16 compute / f32 params, full train step (fwd + bwd + SGD update),
 steady state over 20 steps after 3 warmup steps.  Override via env:
 BENCH_BATCH, BENCH_H, BENCH_W, BENCH_STEPS, BENCH_F32=1.
 
-Measured history (one v5e chip): b4 41.8 -> b8 85.5 -> b16 92.7 img/s (the
-batch=1-per-device reference habit leaves half the chip idle).
+Measured history (one v5e chip, 576x768): bf16 b4 41.8 -> b8 85.5 ->
+b16 92.7 img/s (b32 88.7; the batch=1-per-device reference habit leaves
+half the chip idle); full-f32 b16 61.8 img/s.
 """
 
 import json

@@ -23,3 +23,6 @@ __all__ = [
     "load_vgg16_frontend",
     "param_count",
 ]
+
+from can_tpu.models.flax_module import CANNet as FlaxCANNet  # noqa: E402
+__all__.append("FlaxCANNet")

@@ -291,3 +291,14 @@ def load_vgg16_frontend(params: dict, npz_path: str) -> dict:
 
 def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+if __name__ == "__main__":
+    # forward smoke, the reference's inline check (model/CANNet.py:125-129)
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    _p = cannet_init(_jax.random.key(0))
+    _out = _jax.jit(lambda p, x: cannet_apply(p, x))(_p, _jnp.ones((1, 256, 256, 3)))
+    print(f"CANNet forward: {_out.shape}, mean {float(_out.mean()):.3e}, "
+          f"{param_count(_p):,} params")

@@ -1,0 +1,76 @@
+"""Flax (linen) facade over the functional CANNet.
+
+The core model is a pure params-pytree + apply function (models/cannet.py)
+because that composes directly with shard_map/custom ops injection.  This
+module wraps it in the ``nn.Module`` interface for users arriving from the
+Flax ecosystem (BASELINE.json north star phrasing: "reimplement
+model/CANNet.py ... as a Flax module"):
+
+    model = CANNet()
+    variables = model.init(jax.random.key(0), jnp.ones((1, 256, 256, 3)))
+    out = model.apply(variables, images)
+
+    bn = CANNet(batch_norm=True)
+    vs = bn.init(key, x)
+    out, mutated = bn.apply(vs, x, train=True, mutable=["batch_stats"])
+
+The parameter tree is THE functional tree (key ``cannet``) — checkpoints and
+the functional API interoperate with zero conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+
+from can_tpu.models.cannet import (
+    LocalOps,
+    cannet_apply,
+    cannet_init,
+    init_batch_stats,
+)
+
+
+class CANNet(nn.Module):
+    """CVPR'19 Context-Aware Crowd Counting network (NHWC in, density out)."""
+
+    batch_norm: bool = False
+    compute_dtype: Any = None
+    ops: Optional[LocalOps] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        tree = self.param(
+            "cannet",
+            lambda rng: cannet_init(rng, batch_norm=self.batch_norm))
+        kwargs = {}
+        if self.compute_dtype is not None:
+            kwargs["compute_dtype"] = self.compute_dtype
+        if self.ops is not None:
+            kwargs["ops"] = self.ops
+        if not self.batch_norm:
+            return cannet_apply(tree, x, **kwargs)
+
+        stats = self.variable("batch_stats", "stats",
+                              lambda: init_batch_stats(tree))
+        if train:
+            out, new_stats = cannet_apply(tree, x, batch_stats=stats.value,
+                                          train=True, **kwargs)
+            if not self.is_initializing():
+                stats.value = jax.lax.stop_gradient(new_stats)
+            return out
+        return cannet_apply(tree, x, batch_stats=stats.value, train=False,
+                            **kwargs)
+
+
+def functional_params(variables) -> dict:
+    """Extract the functional params tree from a Flax variables dict."""
+    return variables["params"]["cannet"]
+
+
+def functional_batch_stats(variables):
+    """Extract the functional batch_stats tree (None for the plain model)."""
+    bs = variables.get("batch_stats")
+    return None if bs is None else bs["stats"]

@@ -105,3 +105,69 @@ def test_step_timer_fences():
         x = jnp.ones((100, 100)) @ jnp.ones((100, 100))
         t.stop(x)
     assert t.mean > 0
+
+
+class TestDeterminism:
+    def test_resume_equals_straight_run(self, tmp_path):
+        """checkpoint -> restore -> continue == training straight through
+        (full-state checkpoints; the reference loses optimizer momentum and
+        the epoch counter, SURVEY §5)."""
+        import jax
+        from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+        from can_tpu.train import (create_train_state, make_lr_schedule,
+                                   make_optimizer, train_one_epoch)
+        from tests.test_train import random_batch, tiny_apply, tiny_init
+
+        mesh = make_mesh(jax.devices()[:8])
+        opt = make_optimizer(make_lr_schedule(1e-8, world_size=8))
+        params = tiny_init(jax.random.key(3))
+        rng = np.random.default_rng(11)
+        batches = [random_batch(rng) for _ in range(4)]
+        step = make_dp_train_step(tiny_apply, opt, mesh, donate=False)
+        put = lambda b: make_global_batch(b, mesh)
+
+        s_straight = create_train_state(jax.tree.map(jnp.array, params), opt)
+        for ep in range(2):
+            s_straight, _ = train_one_epoch(step, s_straight, batches,
+                                            put_fn=put, epoch=ep,
+                                            show_progress=False)
+
+        s_a = create_train_state(jax.tree.map(jnp.array, params), opt)
+        s_a, _ = train_one_epoch(step, s_a, batches, put_fn=put, epoch=0,
+                                 show_progress=False)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(0, s_a, mae=1.0)
+        mgr.wait()
+        s_b = mgr.restore(create_train_state(
+            jax.tree.map(jnp.array, params), opt))
+        mgr.close()
+        s_b, _ = train_one_epoch(step, s_b, batches, put_fn=put, epoch=1,
+                                 show_progress=False)
+
+        assert int(s_b.step) == int(s_straight.step)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), s_b.params, s_straight.params)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), s_b.opt_state, s_straight.opt_state)
+
+    def test_same_seed_reproduces_cli_run(self, data_root, tmp_path):
+        """Two CLI runs with the same seed produce identical checkpoints
+        (the reference seeds with time.time(), train.py:66)."""
+        import jax
+        from can_tpu.cli.train import main as train_main
+        from can_tpu.models import cannet_init
+        from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+
+        outs = []
+        for tag in ("a", "b"):
+            ck = str(tmp_path / f"ck_{tag}")
+            assert train_main(["--data_root", data_root, "--epochs", "1",
+                               "--batch-size", "1", "--checkpoint-dir", ck,
+                               "--seed", "42"]) == 0
+            opt = make_optimizer(make_lr_schedule(1e-7))
+            state = create_train_state(cannet_init(jax.random.key(42)), opt)
+            mgr = CheckpointManager(ck)
+            outs.append(mgr.restore(state))
+            mgr.close()
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), outs[0].params, outs[1].params)
