@@ -120,14 +120,8 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     # fail fast on incompatible flag combinations (before any expensive
     # model/optimizer/checkpoint work)
-    if args.syncBN and args.sp > 1:
-        raise SystemExit("--syncBN is not supported with --sp > 1 (the "
-                         "spatial-parallel step does not thread BN stats)")
     if args.pallas_context and args.sp > 1:
         raise SystemExit("--pallas-context is incompatible with --sp > 1")
-    if args.remat and args.sp > 1:
-        raise SystemExit("--remat is not wired into the spatial-parallel "
-                         "step yet; drop one of --remat / --sp")
     apply_platform(args)
     topo = init_runtime()
     if args.pallas_context and jax.device_count() > 1:
@@ -204,7 +198,8 @@ def main(argv=None) -> int:
     if args.sp > 1:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
-                                          compute_dtype=compute_dtype))
+                                          compute_dtype=compute_dtype,
+                                          remat=args.remat))
 
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)

@@ -63,6 +63,12 @@ class LocalOps:
     # Optional fused context tail: (fv, [ave_k], [W_k], hw) -> fi
     # (ops/pallas_context.py provides the TPU kernel).
     context_fused: Any = None
+    # Collective axis name(s) for cross-shard BatchNorm moments under
+    # shard_map (SyncBN over an explicit mesh), plus the static total shard
+    # count those axes span (for the unbiased-variance correction).  None
+    # means moments are taken over the local (possibly GSPMD-global) batch.
+    bn_axes: Any = None
+    bn_shards: int = 1
 
 
 def cannet_init(key: jax.Array, dtype=jnp.float32, *,
@@ -172,7 +178,8 @@ def cannet_apply(
                        dilation=dilation, precision=precision)
         if bn:
             stats = None if batch_stats is None else batch_stats[group][i]
-            y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum)
+            y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum,
+                                     axes=ops.bn_axes, n_shards=ops.bn_shards)
             if new_stats is not None:
                 new_stats[group].append(updated)
         return jax.nn.relu(y)
@@ -238,14 +245,27 @@ def context_block(cparams: Mapping, fv: jax.Array, *,
 
 
 def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
-                eps: float = 1e-5):
+                eps: float = 1e-5, *, axes=None, n_shards: int = 1):
     """torch-semantics BatchNorm2d over NHWC: normalize with biased batch
-    var in train mode, update running stats with unbiased var; f32 stats."""
+    var in train mode, update running stats with unbiased var; f32 stats.
+
+    ``axes`` names shard_map mesh axes to ``pmean`` the batch moments over —
+    equal-sized shards make the pmean of local means the exact global mean,
+    so the sharded model IS SyncBatchNorm (the reference's
+    convert_sync_batchnorm, train.py:116-118, without a wrapper module).
+    """
     yf = y.astype(jnp.float32)
     if train:
-        mean = jnp.mean(yf, axis=(0, 1, 2))
-        var = jnp.var(yf, axis=(0, 1, 2))  # biased, used for normalization
-        n = int(np.prod([y.shape[0], y.shape[1], y.shape[2]]))
+        if axes:
+            # two-pass global moments over the mesh: mean first, then the
+            # centered second moment (stabler than E[x^2] - E[x]^2)
+            mean = jax.lax.pmean(jnp.mean(yf, axis=(0, 1, 2)), axes)
+            var = jax.lax.pmean(
+                jnp.mean(jnp.square(yf - mean), axis=(0, 1, 2)), axes)
+        else:
+            mean = jnp.mean(yf, axis=(0, 1, 2))
+            var = jnp.var(yf, axis=(0, 1, 2))  # biased, for normalization
+        n = int(np.prod([y.shape[0], y.shape[1], y.shape[2]])) * n_shards
         unbiased = var * (n / max(n - 1, 1))
         if stats is not None:
             updated = {

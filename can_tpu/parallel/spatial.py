@@ -61,11 +61,17 @@ def halo_exchange_rows(x: jax.Array, halo: int, axis_name: str,
 
 
 def make_spatial_ops(axis_name: str, axis_size: int,
-                     feat_hw: Tuple[int, int]) -> LocalOps:
+                     feat_hw: Tuple[int, int], *,
+                     bn_axes=None, bn_shards: int = 1) -> LocalOps:
     """LocalOps whose spatial primitives communicate over ``axis_name``.
 
     feat_hw: GLOBAL feature-map (H/8, W) shape after the VGG frontend — the
     upsample target and pooling-matrix extent.
+
+    bn_axes/bn_shards: mesh axes (and their total size) that BatchNorm batch
+    moments pmean over in train mode — (data, spatial) in the train step, so
+    a BN model under dp x sp sees exactly the global-batch statistics
+    (SyncBN; reference train.py:116-118).
     """
 
     def conv2d_sp(x, w, b=None, *, dilation: int = 1, padding=None,
@@ -117,6 +123,8 @@ def make_spatial_ops(axis_name: str, axis_size: int,
         adaptive_pool=adaptive_pool_sp,
         upsample=upsample_sp,
         global_hw=feat_hw,
+        bn_axes=bn_axes,
+        bn_shards=bn_shards,
     )
 
 
@@ -159,22 +167,33 @@ def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
 
 
 def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
-                       compute_dtype=None, donate: bool = True) -> Callable:
+                       compute_dtype=None, donate: bool = True,
+                       remat: bool = False) -> Callable:
     """Jitted train step with BOTH data and spatial parallelism.
 
     Batch dict layout: image (B, H, W, 3), dmap/pixel_mask (B, H/8, W/8, 1),
     sample_mask (B,) — B sharded over ``data``, H over ``spatial``.
     DDP-parity grad scaling divides by the data-parallel size only (the
     spatial shards jointly compute ONE replica's gradient).
+
+    BN models (state.batch_stats is a tree) get SyncBN: batch moments are
+    pmean'd over (data, spatial) inside the shard_map body, so statistics
+    equal the global-batch ones exactly (reference train.py:116-118 made
+    real in every parallelism mode).
+
+    remat=True rematerialises the sharded forward in backward
+    (``jax.checkpoint``) — the combination that serves very large images
+    (UCF-QNRF scale): H-sharding splits the activations across chips AND
+    remat stops the VGG activations from living in HBM at once.
     """
     sp = mesh.shape[SPATIAL_AXIS]
+    dp = mesh.shape[DATA_AXIS]
     h, w = image_hw
     _check_spatial_shapes(h, sp)
     feat_hw = (h // 8, w // 8)
-    ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw)
-
-    def sharded_apply(params, image, compute_dtype=compute_dtype):
-        return cannet_apply(params, image, ops=ops, compute_dtype=compute_dtype)
+    ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw,
+                           bn_axes=(DATA_AXIS, SPATIAL_AXIS),
+                           bn_shards=dp * sp)
 
     bspec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
     batch_specs = {"image": bspec, "dmap": bspec, "pixel_mask": bspec,
@@ -182,18 +201,40 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
 
     def wrapped(state, batch):
         # run the whole step under one shard_map; loss/metrics psum'd global
-        def body(state, batch):
-            # Differentiate the LOCAL (per-shard) loss — no collective inside
-            # loss_fn, so the cotangent seed is an unambiguous 1 per shard —
-            # then explicitly psum grads and loss.  (Putting the psum inside
-            # loss_fn is a trap under check_vma=False: its transpose re-psums
-            # the cotangent, scaling every gradient by the mesh size.)
-            def loss_fn(params):
-                pred = sharded_apply(params, batch["image"])
-                local_sse = masked_mse_sum(pred, batch)
-                return local_sse / mesh.shape[DATA_AXIS], local_sse
+        has_bn = state.batch_stats is not None
 
-            grads, local_sse = jax.grad(loss_fn, has_aux=True)(state.params)
+        def body(state, batch):
+            # Differentiate the LOCAL (per-shard) loss, then explicitly psum
+            # grads and loss.  (Under check_vma=False a forward psum
+            # transposes to a psum of the cotangent — for the replicated
+            # scalar-loss seed that would scale gradients by the mesh size,
+            # so the loss stays local; for the BN-moment pmeans below the
+            # per-shard cotangents are DISTINCT and psum-of-cotangents is
+            # exactly the cross-shard term of the true global gradient, so
+            # collectives inside the forward are correct.)
+            def fwd(params, image):
+                if has_bn:
+                    return cannet_apply(params, image, ops=ops,
+                                        compute_dtype=compute_dtype,
+                                        batch_stats=state.batch_stats,
+                                        train=True)
+                return cannet_apply(params, image, ops=ops,
+                                    compute_dtype=compute_dtype)
+
+            if remat:
+                fwd = jax.checkpoint(fwd)
+
+            def loss_fn(params):
+                if has_bn:
+                    pred, new_stats = fwd(params, batch["image"])
+                else:
+                    pred = fwd(params, batch["image"])
+                    new_stats = None
+                local_sse = masked_mse_sum(pred, batch)
+                return local_sse / dp, (local_sse, new_stats)
+
+            grads, (local_sse, new_stats) = jax.grad(
+                loss_fn, has_aux=True)(state.params)
             grads = jax.tree.map(
                 lambda g: lax.psum(g, (DATA_AXIS, SPATIAL_AXIS)), grads)
             sse = lax.psum(local_sse, (DATA_AXIS, SPATIAL_AXIS))
@@ -205,8 +246,10 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
                 "loss": sse,
                 "num_valid": lax.psum(jnp.sum(batch["sample_mask"]), DATA_AXIS),
             }
-            return state.replace(step=state.step + 1, params=params,
-                                 opt_state=opt_state), metrics
+            return state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state,
+                batch_stats=(jax.lax.stop_gradient(new_stats)
+                             if has_bn else state.batch_stats)), metrics
 
         return shard_map(
             body, mesh=mesh,
@@ -244,9 +287,12 @@ def make_sp_eval_step(mesh: Mesh, image_hw: Tuple[int, int], *,
     batch_specs = {"image": bspec, "dmap": bspec, "pixel_mask": bspec,
                    "sample_mask": P(DATA_AXIS)}
 
-    def body(params, batch):
+    def body(params, batch, batch_stats):
+        # eval-mode BN consumes replicated running stats — pointwise per
+        # channel, so no extra collective is needed under sp
         pred = cannet_apply(params, batch["image"], ops=ops,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            batch_stats=batch_stats, train=False)
         mask = batch["pixel_mask"] * batch["sample_mask"][:, None, None, None]
         et_part = jnp.sum(pred.astype(jnp.float32) * mask, axis=(1, 2, 3))
         gt_part = jnp.sum(batch["dmap"] * mask, axis=(1, 2, 3))
@@ -261,16 +307,7 @@ def make_sp_eval_step(mesh: Mesh, image_hw: Tuple[int, int], *,
 
     repl = NamedSharding(mesh, P())
     batch_shardings = {k: NamedSharding(mesh, v) for k, v in batch_specs.items()}
-    sharded = shard_map(body, mesh=mesh, in_specs=(P(), batch_specs),
-                        out_specs=P(), check_vma=False)
-
-    # evaluate() calls eval_step(params, batch, batch_stats); BN is not
-    # supported under sp, so accept-and-reject the third argument
-    def step(params, batch, batch_stats=None):
-        if batch_stats is not None:
-            raise ValueError("BN models are not supported under spatial "
-                             "parallelism")
-        return sharded(params, batch)
-
+    step = shard_map(body, mesh=mesh, in_specs=(P(), batch_specs, P()),
+                     out_specs=P(), check_vma=False)
     return jax.jit(step, in_shardings=(repl, batch_shardings, repl),
                    out_shardings=repl)
