@@ -3,13 +3,29 @@
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 
-from can_tpu.data import CrowdDataset
 from can_tpu.parallel import make_mesh
-from can_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+
+def parse_pad_multiple(value):
+    """CLI --pad-multiple value -> ShardedBatcher pad_multiple.
+
+    "auto" (the default): pick from the dataset's shape histogram so the
+    step compiles at most ``max_buckets`` programs; "exact"/"none"/"0":
+    exact snapped shapes (zero padding, bit-exact reference loss math, but
+    one compile per distinct resolution); otherwise an integer multiple.
+    """
+    if value is None:
+        return None
+    s = str(value).strip().lower()
+    if s == "auto":
+        return "auto"
+    if s in ("exact", "none", "0"):
+        return None
+    return int(s)
 
 
 def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from can_tpu.cli.common import dataset_roots
+from can_tpu.cli.common import dataset_roots, parse_pad_multiple
 from can_tpu.data import CrowdDataset, ShardedBatcher
 from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
 from can_tpu.parallel import (
@@ -24,6 +24,7 @@ from can_tpu.parallel import (
     make_mesh,
     process_count,
     process_index,
+    shutdown_runtime,
 )
 from can_tpu.train import create_train_state, evaluate, make_lr_schedule, make_optimizer
 from can_tpu.utils import CheckpointManager, save_density_visualization
@@ -38,7 +39,13 @@ def parse_args(argv=None):
                    help="checkpoint epoch (default: best by MAE, else latest)")
     p.add_argument("--batch-size", type=int, default=1,
                    help="images per device")
-    p.add_argument("--pad-multiple", type=int, default=None)
+    p.add_argument("--pad-multiple", type=str, default="exact",
+                   help="'exact' (default): per-resolution compiles but "
+                        "bit-exact boundary math — eval is the parity "
+                        "oracle, so correctness beats compile time here; "
+                        "'auto' bounds compiled shapes (padding shifts the "
+                        "conv boundary, perturbing edge-adjacent cells); "
+                        "or an int multiple")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--show-index", type=int, default=None,
@@ -75,38 +82,47 @@ def main(argv=None) -> int:
 
     apply_platform(args)
     init_runtime()
-    params, batch_stats = load_params(args)
-    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    try:
+        params, batch_stats = load_params(args)
+        compute_dtype = jnp.bfloat16 if args.bf16 else None
 
-    img_root, gt_root = dataset_roots(args.data_root, args.split)
-    ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test")
-    mesh = make_mesh()
-    # per-host slice of the lockstep schedule, like the train CLI — without
-    # this a multi-host pod would feed every image process_count times
-    local_devices = jax.local_device_count()
-    batcher = ShardedBatcher(ds, args.batch_size * local_devices,
-                             shuffle=False, pad_multiple=args.pad_multiple,
-                             process_index=process_index(),
-                             process_count=process_count())
-    eval_step = make_dp_eval_step(cannet_apply, mesh, compute_dtype=compute_dtype)
-    metrics = evaluate(eval_step, params, batcher.epoch(0),
-                       put_fn=lambda b: make_global_batch(b, mesh),
-                       dataset_size=batcher.dataset_size, show_progress=True,
-                       batch_stats=batch_stats)
-    print(f"[result] images={metrics['num_images']} "
-          f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
+        img_root, gt_root = dataset_roots(args.data_root, args.split)
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test")
+        mesh = make_mesh()
+        # per-host slice of the lockstep schedule, like the train CLI —
+        # without this a multi-host pod would feed every image
+        # process_count times
+        local_devices = jax.local_device_count()
+        batcher = ShardedBatcher(ds, args.batch_size * local_devices,
+                                 shuffle=False,
+                                 pad_multiple=parse_pad_multiple(args.pad_multiple),
+                                 process_index=process_index(),
+                                 process_count=process_count())
+        print(f"[data] buckets={batcher.describe_buckets()} -> "
+              f"{batcher.distinct_shapes(0)} distinct batch shapes "
+              f"(padding overhead {batcher.padding_overhead():.1%})")
+        eval_step = make_dp_eval_step(cannet_apply, mesh,
+                                      compute_dtype=compute_dtype)
+        metrics = evaluate(eval_step, params, batcher.epoch(0),
+                           put_fn=lambda b: make_global_batch(b, mesh),
+                           dataset_size=batcher.dataset_size,
+                           show_progress=True, batch_stats=batch_stats)
+        print(f"[result] images={metrics['num_images']} "
+              f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
-    if args.show_index is not None:
-        from can_tpu.cli.common import make_inference_forward
+        if args.show_index is not None:
+            from can_tpu.cli.common import make_inference_forward
 
-        img, gt = ds[args.show_index]
-        et = make_inference_forward()(params, jnp.asarray(img)[None],
-                                      batch_stats)
-        paths = save_density_visualization(
-            img, gt, np.asarray(et)[0], args.out_dir,
-            tag=f"{args.split}_{args.show_index}")
-        print(f"[viz] wrote {paths}")
-    return 0
+            img, gt = ds[args.show_index]
+            et = make_inference_forward()(params, jnp.asarray(img)[None],
+                                          batch_stats)
+            paths = save_density_visualization(
+                img, gt, np.asarray(et)[0], args.out_dir,
+                tag=f"{args.split}_{args.show_index}")
+            print(f"[viz] wrote {paths}")
+        return 0
+    finally:
+        shutdown_runtime()  # the reference leaks its process group (SURVEY §3.1)
 
 
 if __name__ == "__main__":

@@ -31,7 +31,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from can_tpu.cli.common import SpatialStepCache, build_mesh_and_batch, dataset_roots
+from can_tpu.cli.common import (
+    SpatialStepCache,
+    build_mesh_and_batch,
+    dataset_roots,
+    parse_pad_multiple,
+)
 from can_tpu.data import CrowdDataset, ShardedBatcher
 from can_tpu.models import (
     cannet_apply,
@@ -86,9 +91,16 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--sp", type=int, default=1,
                    help="spatial (image-height) shards per replica")
-    p.add_argument("--pad-multiple", type=int, default=None,
-                   help="bucket H,W up to this multiple (default: exact shapes)")
-    p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    p.add_argument("--pad-multiple", type=str, default="auto",
+                   help="bucket H,W up to this multiple; 'auto' (default) "
+                        "picks the smallest multiple that bounds the number "
+                        "of distinct compiled shapes; 'exact' buckets by "
+                        "exact snapped shape (zero padding, unbounded "
+                        "compiles on wild datasets)")
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute (f32 params/accumulation on TPU; "
+                        "on cpu/gpu backends bf16 may accumulate at lower "
+                        "precision)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialise the forward in backward "
                         "(jax.checkpoint): ~1/3 more FLOPs for far less "
@@ -137,26 +149,42 @@ def main(argv=None) -> int:
 
     mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
-    pad_multiple = args.pad_multiple
+    pad_multiple = parse_pad_multiple(args.pad_multiple)
+    min_pad = None
     if args.sp > 1:
+        # H must divide into sp shards of /8-aligned feature rows, so every
+        # bucket shape has to be a multiple of 8*sp
         need = 8 * args.sp
-        if pad_multiple is None or pad_multiple % need:
-            pad_multiple = need if pad_multiple is None else (
-                -(-pad_multiple // need) * need)
-            if main_proc:
-                print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
+        min_pad = need
+        if pad_multiple is None:
+            pad_multiple = need
+        elif isinstance(pad_multiple, int) and pad_multiple % need:
+            pad_multiple = -(-pad_multiple // need) * need
+        if main_proc and pad_multiple != "auto":
+            print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
 
     train_img, train_gt = dataset_roots(args.data_root, "train")
     test_img, test_gt = dataset_roots(args.data_root, "test")
     train_ds = CrowdDataset(train_img, train_gt, gt_downsample=8, phase="train")
     test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test")
     common = dict(seed=args.seed, process_index=process_index(),
-                  process_count=process_count(), pad_multiple=pad_multiple)
+                  process_count=process_count(), pad_multiple=pad_multiple,
+                  min_pad_multiple=min_pad)
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:
         print(f"[data] train={len(train_ds)} test={len(test_ds)} "
               f"host_batch={host_batch} dp={dp} sp={args.sp}")
+        # compile-count telemetry: every distinct bucket shape compiles its
+        # own executable, so this number is the first-epoch compile bill
+        for tag, b in (("train", train_batcher), ("test", test_batcher)):
+            n = b.distinct_shapes(0)
+            print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
+                  f"{n} distinct batch shapes "
+                  f"(padding overhead {b.padding_overhead():.1%})")
+            if n > 4 * b.max_buckets:
+                print(f"[data] WARNING: {n} shapes will each compile a "
+                      f"program; use --pad-multiple auto to bound this")
 
     # identical init on every host by construction: same seed, same key
     params = cannet_init(jax.random.key(args.seed), batch_norm=args.syncBN)

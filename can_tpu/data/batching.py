@@ -5,10 +5,15 @@ shapes (reference: train.py:84-91,177) — a non-starter under XLA, where every
 distinct shape is a recompile.  TPU-first design:
 
 * **Shape bucketing.** Items are grouped by their post-snap (H, W) — either
-  exactly (``pad_multiple=None``: zero padding, bit-exact reference math) or
-  rounded up to a multiple (bounded compile count for wild datasets).  Each
-  bucket shape compiles once; afterwards every batch of that shape reuses the
-  executable.
+  exactly (``pad_multiple=None``: zero padding, bit-exact reference math),
+  rounded up to a multiple (bounded compile count for wild datasets), or
+  ``pad_multiple="auto"``: the batcher reads the dataset's shape histogram
+  (header-only) and picks the smallest multiple that keeps the number of
+  distinct bucket shapes — i.e. XLA compilations — at or under
+  ``max_buckets``.  Each bucket shape compiles once; afterwards every batch
+  of that shape reuses the executable.  (The reference recompiles nothing
+  because torch is eager — but it also gets none of XLA's fusion; bounded
+  bucketing is the TPU-native trade.)
 * **Masking.** A per-image validity flag plus a per-cell mask over the 1/8
   density grid make padded pixels and fill items contribute exactly zero to
   loss/metrics, so MSE-sum and MAE match the reference's per-image math.
@@ -54,6 +59,14 @@ class Batch:
         return int(self.sample_mask.sum())
 
 
+def _ceil_bound(v: int, bounds: Tuple[int, ...]) -> int:
+    """Smallest ladder bound >= v (bounds sorted ascending; last covers max)."""
+    for b in bounds:
+        if b >= v:
+            return b
+    return bounds[-1]
+
+
 def pad_batch(items, bucket_hw: Tuple[int, int], batch_size: int,
               valid_flags, ds: int) -> Batch:
     """Assemble variable-size (img, dmap) numpy pairs into one padded Batch."""
@@ -85,23 +98,115 @@ class ShardedBatcher:
 
     def __init__(self, dataset, batch_size: int, *, shuffle: bool = True,
                  seed: int = 0, process_index: int = 0, process_count: int = 1,
-                 pad_multiple: Optional[int] = None, ds: int = 8):
-        if pad_multiple is not None and pad_multiple % ds != 0:
-            raise ValueError(
-                f"pad_multiple ({pad_multiple}) must be a multiple of the "
-                f"density downsample factor ({ds})")
+                 pad_multiple=None, ds: int = 8, max_buckets: int = 8,
+                 min_pad_multiple: Optional[int] = None):
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.seed = int(seed)
         self.process_index = int(process_index)
         self.process_count = int(process_count)
-        self.pad_multiple = pad_multiple
         self.ds = int(ds)
+        self.max_buckets = int(max_buckets)
         # snapped shapes are immutable per item: cache them so repeated
         # schedule builds (batches_per_epoch + every epoch) don't re-open
         # every image header
         self._shape_cache: Dict[int, Tuple[int, int]] = {}
+        self.bucket_ladder: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
+        if pad_multiple == "auto":
+            pad_multiple = self._resolve_auto_buckets(min_pad_multiple)
+        if pad_multiple is not None and pad_multiple % self.ds != 0:
+            raise ValueError(
+                f"pad_multiple ({pad_multiple}) must be a multiple of the "
+                f"density downsample factor ({self.ds})")
+        self.pad_multiple = pad_multiple
+
+    def _item_shape(self, idx: int) -> Tuple[int, int]:
+        hw = self._shape_cache.get(idx)
+        if hw is None:
+            hw = self._shape_cache[idx] = self.dataset.snapped_shape(idx)
+        return hw
+
+    @staticmethod
+    def _axis_bounds(values, k: int, floor: int) -> Tuple[int, ...]:
+        """k quantile upper bounds for one axis, rounded up to ``floor``
+        multiples (so every bucket H works under spatial sharding too)."""
+        vs = sorted(values)
+        n = len(vs)
+        bounds = set()
+        for i in range(1, k + 1):
+            v = vs[-(-i * n // k) - 1]  # ceil(i*n/k)-1: i-th quantile's top
+            bounds.add(-(-v // floor) * floor)
+        return tuple(sorted(bounds))
+
+    def _resolve_auto_buckets(self, min_pad_multiple: Optional[int]) -> Optional[int]:
+        """Choose static bucket shapes so each train/eval step compiles at
+        most ``max_buckets`` programs.
+
+        Snapped shapes are already multiples of ``ds``, so when the exact
+        shape set is small enough, exact bucketing (None) wins: zero
+        padding, bit-exact reference loss math.  Otherwise build a
+        per-axis quantile ladder: split the H and W histograms into
+        kH x kW quantile cells (every (kH, kW) split of the budget is
+        scored by its padded-area overhead and the cheapest wins), and pad
+        each image up to its cell's (H, W) upper bounds.  This beats any
+        single global multiple on wild datasets — buckets concentrate
+        where the shapes actually are.
+        """
+        shapes = [self._item_shape(i) for i in range(len(self.dataset))]
+        if not shapes:
+            return None
+        floor = max(self.ds, int(min_pad_multiple or 0))
+        if floor % self.ds:
+            floor = -(-floor // self.ds) * self.ds
+        if floor == self.ds and len(set(shapes)) <= self.max_buckets:
+            return None
+        hs = [h for h, _ in shapes]
+        ws = [w for _, w in shapes]
+        best = None
+        for kh in range(1, self.max_buckets + 1):
+            kw = self.max_buckets // kh
+            if kw < 1:
+                continue
+            hb = self._axis_bounds(hs, kh, floor)
+            wb = self._axis_bounds(ws, kw, floor)
+            if len(hb) * len(wb) > self.max_buckets:
+                continue
+            pad_area = sum(_ceil_bound(h, hb) * _ceil_bound(w, wb)
+                           for h, w in shapes)
+            if best is None or pad_area < best[0]:
+                best = (pad_area, hb, wb)
+        if best is None:  # budget < any grid: one bucket covering the max
+            hb = (-(-max(hs) // floor) * floor,)
+            wb = (-(-max(ws) // floor) * floor,)
+            best = (0, hb, wb)
+        _, hb, wb = best
+        self.bucket_ladder = (hb, wb)
+        return None
+
+    def padding_overhead(self) -> float:
+        """Fraction of padded-batch pixels that are fill (0 = exact shapes).
+        Uses the full dataset histogram, weighting each item by its bucket."""
+        shapes = [self._item_shape(i) for i in range(len(self.dataset))]
+        if not shapes:
+            return 0.0
+        item_area = sum(h * w for h, w in shapes)
+        bucket_area = sum(bh * bw for bh, bw in map(self._bucket_key, shapes))
+        return bucket_area / max(item_area, 1) - 1.0
+
+    def describe_buckets(self) -> str:
+        """One-line bucket-policy summary for startup telemetry."""
+        if self.bucket_ladder is not None:
+            hb, wb = self.bucket_ladder
+            return f"auto ladder H{list(hb)} x W{list(wb)}"
+        if self.pad_multiple is None:
+            return "exact shapes"
+        return f"multiple of {self.pad_multiple}"
+
+    def distinct_shapes(self, epoch: int = 0) -> int:
+        """Number of distinct bucket shapes in this epoch's schedule — a
+        lower bound on XLA compile count for the train step."""
+        return len({key for key, _ in self.global_schedule(epoch)})
 
     @property
     def dataset_size(self) -> int:
@@ -109,6 +214,9 @@ class ShardedBatcher:
         return len(self.dataset)
 
     def _bucket_key(self, hw: Tuple[int, int]) -> Tuple[int, int]:
+        if self.bucket_ladder is not None:
+            hb, wb = self.bucket_ladder
+            return (_ceil_bound(hw[0], hb), _ceil_bound(hw[1], wb))
         if self.pad_multiple is None:
             return hw
         m = self.pad_multiple
@@ -127,10 +235,7 @@ class ShardedBatcher:
         pending: Dict[Tuple[int, int], List[Tuple[int, bool]]] = {}
         schedule = []
         for idx in order.tolist():
-            hw = self._shape_cache.get(idx)
-            if hw is None:
-                hw = self._shape_cache[idx] = self.dataset.snapped_shape(idx)
-            key = self._bucket_key(hw)
+            key = self._bucket_key(self._item_shape(idx))
             group = pending.setdefault(key, [])
             group.append((idx, True))
             if len(group) == gbs:

@@ -29,6 +29,11 @@ def conv2d(x, w, b=None, *, dilation: int = 1, padding=None, precision=None):
     # NOTE: no preferred_element_type here — TPU's MXU already accumulates
     # bf16 convs in f32 internally, and requesting an f32 output + downcast
     # breaks the transpose rule (dtype-mismatched cotangent convs in grad).
+    # Backend caveat: that "bf16 compute, f32 accumulation" contract is a
+    # TPU hardware property; on the CPU/GPU backends (test suite,
+    # --platform cpu) bf16 convs may accumulate at lower precision.  The
+    # bf16 parity tests therefore compare against bf16-quantised
+    # references, and --bf16 is a TPU-targeted flag.
     out = lax.conv_general_dilated(
         x,
         w,

@@ -1,0 +1,99 @@
+"""float64 SyncBN-under-sp gradient-parity worker (run as a subprocess).
+
+The in-suite f32 comparison (test_batchnorm.py::TestSyncBNSpatial) can only
+assert a ~1.5e-1 noise floor — backprop through ten stacked BNs amplifies
+f32 reduction-order noise.  This worker re-runs the same dp=2 x sp=4 vs
+unsharded one-step comparison under ``jax_enable_x64`` on tiny shapes, where
+any structural gradient error (missing psum, wrong divisor, skewed per-shard
+term) survives undamped: real-gradient parameter deltas must agree to 1e-4
+relative.  Subprocess because x64 is a process-global jax config.
+
+Exit code 0 = parity holds.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
+    from can_tpu.parallel import make_mesh
+    from can_tpu.parallel.spatial import make_sp_train_step
+    from can_tpu.train import (
+        create_train_state,
+        make_lr_schedule,
+        make_optimizer,
+        make_train_step,
+    )
+
+    mesh = make_mesh(jax.devices()[:8], dp=2, sp=4)
+    h, w = 64, 32  # smallest shape valid under sp=4 (>=2 feature rows/shard)
+    params = cannet_init(jax.random.key(0), batch_norm=True)
+    params = jax.tree.map(lambda p: p.astype(jnp.float64), params)
+    opt = make_optimizer(make_lr_schedule(1e-3, world_size=2))
+    rng = np.random.default_rng(3)
+    batch_np = {
+        "image": rng.normal(size=(2, h, w, 3)),
+        "dmap": rng.uniform(size=(2, h // 8, w // 8, 1)),
+        "pixel_mask": np.ones((2, h // 8, w // 8, 1)),
+        "sample_mask": np.ones((2,)),
+    }
+    spec = {
+        "image": P("data", "spatial", None, None),
+        "dmap": P("data", "spatial", None, None),
+        "pixel_mask": P("data", "spatial", None, None),
+        "sample_mask": P("data"),
+    }
+    gbatch = {k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec[k]))
+              for k, v in batch_np.items()}
+
+    step_sp = make_sp_train_step(opt, mesh, (h, w), donate=False)
+    s_sp = create_train_state(jax.tree.map(jnp.array, params), opt,
+                              init_batch_stats(params))
+    s_sp, m_sp = step_sp(s_sp, gbatch)
+
+    step_1 = jax.jit(make_train_step(cannet_apply, opt, grad_divisor=2))
+    s_1 = create_train_state(jax.tree.map(jnp.array, params), opt,
+                             init_batch_stats(params))
+    s_1, m_1 = step_1(s_1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+
+    loss_rel = abs(float(m_sp["loss"]) - float(m_1["loss"])) / abs(float(m_1["loss"]))
+    worst = [0.0]
+
+    def walk(p0, a, b):
+        if isinstance(p0, dict):
+            for k in p0:
+                if k == "b" and "bn" in p0:
+                    continue  # pre-BN conv bias: mathematically zero gradient
+                walk(p0[k], a[k], b[k])
+        elif isinstance(p0, (list, tuple)):
+            for x, y, z in zip(p0, a, b):
+                walk(x, y, z)
+        else:
+            da = np.asarray(a) - np.asarray(p0)
+            db = np.asarray(b) - np.asarray(p0)
+            scale = max(np.abs(db).max(), 1e-12)
+            worst[0] = max(worst[0], float(np.abs(da - db).max() / scale))
+
+    walk(params, s_sp.params, s_1.params)
+    print(f"[x64 parity] loss_rel={loss_rel:.3e} worst_delta_rel={worst[0]:.3e}")
+    ok = loss_rel < 1e-6 and worst[0] < 1e-4
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
