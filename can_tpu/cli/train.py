@@ -275,7 +275,9 @@ def main(argv=None) -> int:
                     mae = metrics["mae"]
                     lr_now = float(schedule(int(state.step)))
                     logger.log({"train_loss": float(mean_loss), "mae": mae,
-                                "mse": metrics["mse"], "lr": lr_now},
+                                "mse": metrics["mse"], "lr": lr_now,
+                                "img_per_s": round(mean_loss.img_per_s, 2),
+                                "epoch_s": round(mean_loss.seconds, 2)},
                                step=epoch)
                     ckpt.save(epoch, state, mae=mae,
                               extra={"mse": metrics["mse"]})

@@ -1,7 +1,7 @@
 from .loss import masked_mse_sum, density_counts
 from .state import TrainState, create_train_state, make_optimizer, make_lr_schedule
 from .steps import make_train_step, make_eval_step, NonFiniteLossError
-from .loop import train_one_epoch, evaluate
+from .loop import EpochStats, evaluate, train_one_epoch
 
 __all__ = [
     "masked_mse_sum",
@@ -14,5 +14,6 @@ __all__ = [
     "make_eval_step",
     "NonFiniteLossError",
     "train_one_epoch",
+    "EpochStats",
     "evaluate",
 ]

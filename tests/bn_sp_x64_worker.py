@@ -71,27 +71,12 @@ def main() -> int:
                              init_batch_stats(params))
     s_1, m_1 = step_1(s_1, {k: jnp.asarray(v) for k, v in batch_np.items()})
 
+    from parity_utils import worst_param_delta_rel
+
     loss_rel = abs(float(m_sp["loss"]) - float(m_1["loss"])) / abs(float(m_1["loss"]))
-    worst = [0.0]
-
-    def walk(p0, a, b):
-        if isinstance(p0, dict):
-            for k in p0:
-                if k == "b" and "bn" in p0:
-                    continue  # pre-BN conv bias: mathematically zero gradient
-                walk(p0[k], a[k], b[k])
-        elif isinstance(p0, (list, tuple)):
-            for x, y, z in zip(p0, a, b):
-                walk(x, y, z)
-        else:
-            da = np.asarray(a) - np.asarray(p0)
-            db = np.asarray(b) - np.asarray(p0)
-            scale = max(np.abs(db).max(), 1e-12)
-            worst[0] = max(worst[0], float(np.abs(da - db).max() / scale))
-
-    walk(params, s_sp.params, s_1.params)
-    print(f"[x64 parity] loss_rel={loss_rel:.3e} worst_delta_rel={worst[0]:.3e}")
-    ok = loss_rel < 1e-6 and worst[0] < 1e-4
+    worst = worst_param_delta_rel(params, s_sp.params, s_1.params)
+    print(f"[x64 parity] loss_rel={loss_rel:.3e} worst_delta_rel={worst:.3e}")
+    ok = loss_rel < 1e-6 and worst < 1e-4
     return 0 if ok else 1
 
 

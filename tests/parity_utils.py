@@ -1,0 +1,42 @@
+"""Shared parameter-delta parity traversal (f32 suite test + x64 worker).
+
+One copy of the comparison rule so the f32 smoke check
+(test_batchnorm.py::TestSyncBNSpatial) and the tight x64 subprocess check
+(bn_sp_x64_worker.py) can never silently diverge:
+
+* conv biases that feed directly into a BatchNorm are EXCLUDED — BN's
+  mean-subtraction cancels the bias, so its true gradient is exactly zero
+  and its one-step delta is pure float residue in any implementation;
+* per remaining tensor, the metric is max|delta_a - delta_b| relative to
+  max|delta_b| (deltas measured from the shared initial params).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def param_delta_rel(params0, params_a, params_b):
+    """Yield (path, rel_err) per real-gradient tensor, where rel_err =
+    max|da - db| / max(|db|max, 1e-12) and d* = params_* - params0."""
+
+    def walk(p0, a, b, path):
+        if isinstance(p0, dict):
+            for k in p0:
+                if k == "b" and "bn" in p0:
+                    continue  # pre-BN conv bias: mathematically zero gradient
+                yield from walk(p0[k], a[k], b[k], path + (k,))
+        elif isinstance(p0, (list, tuple)):
+            for i, (x, y, z) in enumerate(zip(p0, a, b)):
+                yield from walk(x, y, z, path + (i,))
+        else:
+            da = np.asarray(a, dtype=np.float64) - np.asarray(p0, dtype=np.float64)
+            db = np.asarray(b, dtype=np.float64) - np.asarray(p0, dtype=np.float64)
+            scale = max(np.abs(db).max(), 1e-12)
+            yield path, float(np.abs(da - db).max() / scale)
+
+    yield from walk(params0, params_a, params_b, ())
+
+
+def worst_param_delta_rel(params0, params_a, params_b) -> float:
+    return max(r for _, r in param_delta_rel(params0, params_a, params_b))

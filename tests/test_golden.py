@@ -1,0 +1,81 @@
+"""Golden-convergence regression — the quality-oracle stand-in.
+
+The reference's correctness oracle is a checkpoint-backed dataset claim:
+ShanghaiTech-A MAE ~62.3 (reference README.md:37, test.py:69).  Real data
+and pretrained VGG weights don't exist in this environment, so this is the
+stand-in: a fully seeded synthetic run with a committed golden outcome.
+Any silent regression in the model math, optimizer semantics, data
+pipeline, or sharded-training parity moves the final MAE and fails here.
+
+The exact ShanghaiTech-A recipe (flags, VGG npz conversion, schedule) for
+when real data exists is documented in README.md ("Reproducing the paper
+number").
+
+GOLDEN values measured on the 8-device CPU mesh (f32).  Tolerance covers
+platform noise (reduction order, conv algorithm choice) — observed
+cross-run drift is ~1e-3 relative on CPU; TPU f32 drifts more, hence the
+5% band on MAE plus a hard "actually learned" floor.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from can_tpu.data import CrowdDataset, ShardedBatcher, make_synthetic_dataset
+from can_tpu.models import cannet_apply, cannet_init
+from can_tpu.parallel import (
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_global_batch,
+    make_mesh,
+)
+from can_tpu.train import (
+    create_train_state,
+    evaluate,
+    make_lr_schedule,
+    make_optimizer,
+    train_one_epoch,
+)
+
+pytestmark = pytest.mark.slow
+
+# committed golden outcome of the fixed recipe below (8-device CPU, f32)
+GOLDEN_FIRST_MAE = 20.8517
+GOLDEN_FINAL_MAE = 14.9687
+
+
+def test_golden_convergence(tmp_path):
+    img_root, gt_root = make_synthetic_dataset(
+        str(tmp_path / "data"), 24, sizes=((64, 64), (64, 96)), seed=42)
+    test_img, test_gt = make_synthetic_dataset(
+        str(tmp_path / "test"), 8, sizes=((64, 64),), seed=43)
+
+    train_ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="train")
+    test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test")
+    mesh = make_mesh(jax.devices()[:8])
+    train_b = ShardedBatcher(train_ds, 8, shuffle=True, seed=0)
+    test_b = ShardedBatcher(test_ds, 8, shuffle=False, seed=0)
+
+    opt = make_optimizer(make_lr_schedule(2e-6, world_size=8))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh)
+    ev = make_dp_eval_step(cannet_apply, mesh)
+    put = lambda b: make_global_batch(b, mesh)
+
+    maes = []
+    for epoch in range(10):
+        state, _ = train_one_epoch(step, state, train_b.epoch(epoch),
+                                   put_fn=put, epoch=epoch,
+                                   show_progress=False)
+        m = evaluate(ev, state.params, test_b.epoch(0), put_fn=put,
+                     dataset_size=test_b.dataset_size,
+                     batch_stats=state.batch_stats)
+        maes.append(m["mae"])
+
+    assert np.isfinite(maes).all()
+    # learning happened: the committed golden trajectory reproduces
+    assert maes[-1] == pytest.approx(GOLDEN_FINAL_MAE, rel=0.05), maes
+    assert maes[0] == pytest.approx(GOLDEN_FIRST_MAE, rel=0.05), maes
+    # and the hard floor: final error meaningfully below the first epoch's
+    assert maes[-1] < 0.75 * maes[0], maes

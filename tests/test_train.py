@@ -175,6 +175,26 @@ class TestLoops:
                             [random_batch(np.random.default_rng(0))],
                             put_fn=put, show_progress=False)
 
+    def test_epoch_stats_float_compat_and_throughput(self, mesh8):
+        from can_tpu.train import EpochStats
+
+        params = tiny_init(jax.random.key(1))
+        opt = make_optimizer(make_lr_schedule(1e-8, world_size=8))
+        step = make_dp_train_step(tiny_apply, opt, mesh8)
+        put = lambda b: make_global_batch(b, mesh8)
+        rng = np.random.default_rng(7)
+        batches = [random_batch(rng) for _ in range(5)]
+        # check_every=2 exercises mid-epoch flushes + the tail flush
+        _, stats = train_one_epoch(step, create_train_state(params, opt),
+                                   batches, put_fn=put, show_progress=False,
+                                   check_every=2)
+        assert isinstance(stats, EpochStats)
+        assert isinstance(stats, float) and np.isfinite(float(stats))
+        assert stats.steps == 5
+        assert stats.images == sum(b.num_valid for b in batches)
+        assert stats.seconds > 0 and stats.img_per_s > 0
+        assert stats.distinct_shapes >= 1
+
     def test_evaluate_matches_per_image_reference_math(self, mesh8, tmp_path):
         """Masked batched eval == the reference's batch-1 per-image MAE loop
         (utils/train_eval_utils.py:83) on the same predictions."""
