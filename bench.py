@@ -61,20 +61,6 @@ def main() -> None:
     compute_dtype = None if os.environ.get("BENCH_F32") else jnp.bfloat16
 
     apply_fn = cannet_apply
-    suffix = ""
-    if os.environ.get("BENCH_PALLAS") and jax.device_count() > 1:
-        print("# BENCH_PALLAS ignored: kernel is single-device only")
-        os.environ.pop("BENCH_PALLAS")
-    if os.environ.get("BENCH_PALLAS"):
-        from functools import partial as _partial
-
-        from can_tpu.models.cannet import LocalOps
-        from can_tpu.ops.pallas_context import make_fused_context
-
-        ops = LocalOps(context_fused=make_fused_context())
-        apply_fn = _partial(cannet_apply, ops=ops)
-        suffix = "_pallas"
-
     ndev = jax.device_count()
     mesh = make_mesh()
     rng = np.random.default_rng(0)
@@ -110,7 +96,7 @@ def main() -> None:
     per_chip = img_per_s / ndev
     print(json.dumps({
         "metric": f"cannet_train_img_per_s_{h}x{w}_b{b}"
-                  f"{'_f32' if compute_dtype is None else '_bf16'}{suffix}",
+                  f"{'_f32' if compute_dtype is None else '_bf16'}",
         "value": round(img_per_s, 3),
         "unit": "images/sec",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_H100, 3),

@@ -230,8 +230,9 @@ def bench_highres_eval(jnp, compute_dtype, *, h, w, steps, warmup=2):
 
 def main() -> None:
     if os.environ.get("BENCH_SUITE_PLATFORM") == "cpu8":
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
+        from __graft_entry__ import _ensure_cpu_flags
+
+        _ensure_cpu_flags(8)
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -39,7 +39,7 @@ def parse_args(argv=None):
                    help="checkpoint epoch (default: best by MAE, else latest)")
     p.add_argument("--batch-size", type=int, default=1,
                    help="images per device")
-    p.add_argument("--pad-multiple", type=str, default="exact",
+    p.add_argument("--pad-multiple", type=parse_pad_multiple, default="exact",
                    help="'exact' (default): per-resolution compiles but "
                         "bit-exact boundary math — eval is the parity "
                         "oracle, so correctness beats compile time here; "
@@ -94,8 +94,7 @@ def main(argv=None) -> int:
         # process_count times
         local_devices = jax.local_device_count()
         batcher = ShardedBatcher(ds, args.batch_size * local_devices,
-                                 shuffle=False,
-                                 pad_multiple=parse_pad_multiple(args.pad_multiple),
+                                 shuffle=False, pad_multiple=args.pad_multiple,
                                  process_index=process_index(),
                                  process_count=process_count())
         print(f"[data] buckets={batcher.describe_buckets()} -> "

@@ -91,7 +91,7 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--sp", type=int, default=1,
                    help="spatial (image-height) shards per replica")
-    p.add_argument("--pad-multiple", type=str, default="auto",
+    p.add_argument("--pad-multiple", type=parse_pad_multiple, default="auto",
                    help="bucket H,W up to this multiple; 'auto' (default) "
                         "picks the smallest multiple that bounds the number "
                         "of distinct compiled shapes; 'exact' buckets by "
@@ -105,10 +105,6 @@ def parse_args(argv=None):
                    help="rematerialise the forward in backward "
                         "(jax.checkpoint): ~1/3 more FLOPs for far less "
                         "activation HBM — for very large batches/resolutions")
-    p.add_argument("--pallas-context", action="store_true",
-                   help="use the fused Pallas TPU kernel for the context "
-                        "block (single-device forward shapes only; "
-                        "incompatible with --sp > 1)")
     p.add_argument("--vgg16-npz", type=str, default="",
                    help="pretrained VGG-16 frontend .npz (tools/convert_vgg16.py)")
     p.add_argument("--eval-interval", type=int, default=1)
@@ -130,15 +126,8 @@ def apply_platform(args) -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    # fail fast on incompatible flag combinations (before any expensive
-    # model/optimizer/checkpoint work)
-    if args.pallas_context and args.sp > 1:
-        raise SystemExit("--pallas-context is incompatible with --sp > 1")
     apply_platform(args)
     topo = init_runtime()
-    if args.pallas_context and jax.device_count() > 1:
-        raise SystemExit("--pallas-context is single-device only (the "
-                         "Mosaic custom call has no GSPMD partitioning rule)")
     main_proc = is_main_process()
     if main_proc:
         print(f"[runtime] {topo}")
@@ -149,7 +138,7 @@ def main(argv=None) -> int:
 
     mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
-    pad_multiple = parse_pad_multiple(args.pad_multiple)
+    pad_multiple = args.pad_multiple  # parsed by argparse (parse_pad_multiple)
     min_pad = None
     if args.sp > 1:
         # H must divide into sp shards of /8-aligned feature rows, so every
@@ -214,15 +203,6 @@ def main(argv=None) -> int:
             print(f"[resume] no checkpoint in {args.init_checkpoint}; cold start")
 
     apply_fn = cannet_apply
-    if args.pallas_context:
-        from functools import partial
-
-        from can_tpu.models.cannet import LocalOps
-        from can_tpu.ops.pallas_context import make_fused_context
-
-        apply_fn = partial(cannet_apply,
-                           ops=LocalOps(context_fused=make_fused_context()))
-
     if args.sp > 1:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,

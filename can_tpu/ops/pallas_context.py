@@ -20,12 +20,21 @@ kernel inputs, no extra HBM).
 Constraints (else fall back to the jnp path): feature H divisible by the
 row-tile, feature W a multiple of 16 (bf16 sublane), C = 512.
 
-MEASURED (v5e-1, 576x768 b16 bf16 train step): stock XLA 92.7 img/s, this
-kernel 76.5 img/s.  XLA's automatic fusion of the context block is already
-near-optimal, and the custom-VJP recompute pays the context math twice in
-backward, so the kernel is a net LOSS for training — it is kept as an
-opt-in (--pallas-context / BENCH_PALLAS=1) demonstration and as the
-starting point for an inference-only fused path, NOT the default.
+ABLATION (v5e-1, 576x768 b16 bf16) — this kernel LOSES to stock XLA in
+both directions, so no CLI flag routes to it; it stays as a tested library
+component and a worked example of the Pallas fusion pattern:
+
+* train step: stock 92.7 img/s, kernel 76.5 (the custom-VJP recompute pays
+  the context math twice in backward);
+* eval (forward-only, no VJP tax): stock 287 img/s, kernel 274 at the best
+  tile in a (row_tile, max_col_tile) sweep over {8,16,24} x {32,48,96}
+  (272 @ 8x48, 274 @ 8x32, 264 @ 16x48; 96-wide tiles exceed VMEM).
+
+Conclusion recorded per VERDICT r1 item 9: XLA's automatic fusion of this
+block (including the concat that follows it) is simply better than the
+hand tiling here — the MXU matmuls dominate and XLA already keeps the
+intermediates out of HBM.  Use ``make_fused_context()`` directly if you
+want the kernel.
 """
 
 from __future__ import annotations
@@ -97,20 +106,23 @@ except ImportError:  # pragma: no cover
     _PALLAS_OK = False
 
 
-def _pick_col_tile(w: int) -> int:
-    """Largest multiple-of-16 divisor of w that is <= 48 (VMEM budget:
-    ~7 MB/program incl. double buffering at C=512 f32)."""
-    for tw in range(min(w, 48), 0, -16):
+def _pick_col_tile(w: int, max_tw: int) -> int:
+    """Largest multiple-of-16 divisor of w that is <= max_tw (VMEM budget:
+    ~7 MB/program incl. double buffering at C=512 f32 for the default 48)."""
+    for tw in range(min(w, max_tw), 0, -16):
         if w % tw == 0 and tw % 16 == 0:
             return tw
     return w
 
 
-def _fused_forward(fv, avews, uhs, weights, *, interpret=False):
+def _fused_forward(fv, avews, uhs, weights, *, interpret=False,
+                   row_tile=ROW_TILE, max_col_tile=48):
     b, h, w, c = fv.shape
-    tw = _pick_col_tile(w)
-    grid = (b, h // ROW_TILE, w // tw)
-    in_specs = [pl.BlockSpec((1, ROW_TILE, tw, c),
+    while h % row_tile:
+        row_tile //= 2
+    tw = _pick_col_tile(w, max_col_tile)
+    grid = (b, h // row_tile, w // tw)
+    in_specs = [pl.BlockSpec((1, row_tile, tw, c),
                              lambda bi, hi, wi: (bi, hi, wi, 0))]
     for avew in avews:
         s = avew.shape[1]
@@ -124,7 +136,7 @@ def _fused_forward(fv, avews, uhs, weights, *, interpret=False):
         _kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, ROW_TILE, tw, c),
+        out_specs=pl.BlockSpec((1, row_tile, tw, c),
                                lambda bi, hi, wi: (bi, hi, wi, 0)),
         out_shape=jax.ShapeDtypeStruct(fv.shape, fv.dtype),
         interpret=interpret,
@@ -147,17 +159,20 @@ def _reference(fv, avews, uhs, weights):
     return (num / (den + EPS)).astype(fv.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _fused(fv, avews, uhs, weights, interpret=False):
-    return _fused_forward(fv, avews, uhs, weights, interpret=interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(fv, avews, uhs, weights, interpret=False, row_tile=ROW_TILE,
+           max_col_tile=48):
+    return _fused_forward(fv, avews, uhs, weights, interpret=interpret,
+                          row_tile=row_tile, max_col_tile=max_col_tile)
 
 
-def _fused_fwd(fv, avews, uhs, weights, interpret):
-    out = _fused_forward(fv, avews, uhs, weights, interpret=interpret)
+def _fused_fwd(fv, avews, uhs, weights, interpret, row_tile, max_col_tile):
+    out = _fused_forward(fv, avews, uhs, weights, interpret=interpret,
+                         row_tile=row_tile, max_col_tile=max_col_tile)
     return out, (fv, avews, uhs, weights)
 
 
-def _fused_bwd(interpret, residuals, g):
+def _fused_bwd(interpret, row_tile, max_col_tile, residuals, g):
     fv, avews, uhs, weights = residuals
     # recompute-in-backward: differentiate the jnp twin (no saved
     # intermediates, XLA fuses the recompute into the backward)
@@ -172,10 +187,11 @@ def supports(fv_shape) -> bool:
     if not _PALLAS_OK:
         return False
     b, h, w, c = fv_shape
-    return h % ROW_TILE == 0 and w % 16 == 0 and c % 128 == 0
+    return w % 16 == 0 and c % 128 == 0
 
 
-def make_fused_context(*, interpret=False):
+def make_fused_context(*, interpret=False, row_tile=ROW_TILE,
+                       max_col_tile=48):
     """Returns a LocalOps.context_fused callable: (fv, aves, weights, hw)."""
 
     def fused(fv, aves: Sequence, weights: Sequence, hw):
@@ -184,7 +200,8 @@ def make_fused_context(*, interpret=False):
         if not supports(fv.shape):
             return _fallback(fv, aves, weights, hw)
         avews, uhs = _precompute(aves, hw)
-        return _fused(fv, tuple(avews), tuple(uhs), tuple(weights), interpret)
+        return _fused(fv, tuple(avews), tuple(uhs), tuple(weights),
+                      interpret, row_tile, max_col_tile)
 
     def _fallback(fv, aves, weights, hw):
         avews, uhs = _precompute(aves, hw)

@@ -1,11 +1,18 @@
-"""Worker for the real two-process distributed test (test_multiprocess.py).
+"""Worker for the real two-process distributed tests (test_multiprocess.py).
 
-Each process owns 4 virtual CPU devices (global mesh: 8). Runs 2 steps of
-data-parallel CANNet training through the REAL multi-host path —
-jax.distributed rendezvous, lockstep ShardedBatcher,
-make_array_from_process_local_data — and writes the final loss to a file.
+Each process owns 4 virtual CPU devices (global mesh: 8). Runs one epoch of
+CANNet training through the REAL multi-host path — jax.distributed
+rendezvous, lockstep ShardedBatcher, make_array_from_process_local_data —
+and writes the final loss to a file.
 
-Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir>
+Modes:
+  dp    8-way data parallel (the reference's only configuration)
+  dpsp  dp=2 x sp=4 — each process's 4 local devices jointly hold ONE
+        replica's H-sharded activations (halo-exchange convs + psum'd
+        pooling inside, gradient psum over both axes) — the configuration
+        a real pod runs for big images
+
+Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir> [mode]
 """
 
 import os
@@ -22,6 +29,7 @@ jax.config.update("jax_platforms", "cpu")
 def main():
     rank, nprocs, port, out_dir = (int(sys.argv[1]), int(sys.argv[2]),
                                    sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
     from can_tpu.parallel import (
@@ -31,6 +39,7 @@ def main():
         make_mesh,
         shutdown_runtime,
     )
+    from can_tpu.parallel.spatial import make_sp_train_step
     from can_tpu.data import CrowdDataset, ShardedBatcher
     from can_tpu.models import cannet_apply, cannet_init
     from can_tpu.train import (
@@ -48,16 +57,24 @@ def main():
     ds = CrowdDataset(os.path.join(out_dir, "data", "images"),
                       os.path.join(out_dir, "data", "ground_truth"),
                       gt_downsample=8, phase="train")
-    mesh = make_mesh()
-    batcher = ShardedBatcher(ds, 4, shuffle=True, seed=3,
-                             process_index=rank, process_count=nprocs)
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
     state = create_train_state(cannet_init(jax.random.key(0)), opt)
-    step = make_dp_train_step(cannet_apply, opt, mesh)
-    state, mean_loss = train_one_epoch(
-        step, state, batcher.epoch(0),
-        put_fn=lambda b: make_global_batch(b, mesh),
-        show_progress=False)
+    if mode == "dpsp":
+        # dp = nprocs, sp = 4: each process's local devices hold one
+        # replica; the (64, 64) synthetic images H-shard into 4 x 16 rows
+        mesh = make_mesh(dp=nprocs, sp=4)
+        batcher = ShardedBatcher(ds, 2, shuffle=True, seed=3,
+                                 process_index=rank, process_count=nprocs)
+        step = make_sp_train_step(opt, mesh, (64, 64))
+        put = lambda b: make_global_batch(b, mesh, spatial=True)
+    else:
+        mesh = make_mesh()
+        batcher = ShardedBatcher(ds, 4, shuffle=True, seed=3,
+                                 process_index=rank, process_count=nprocs)
+        step = make_dp_train_step(cannet_apply, opt, mesh)
+        put = lambda b: make_global_batch(b, mesh)
+    state, mean_loss = train_one_epoch(step, state, batcher.epoch(0),
+                                       put_fn=put, show_progress=False)
 
     with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
         f.write(f"{mean_loss:.10g}\n")

@@ -1,8 +1,10 @@
 """Fused context-block Pallas kernel: parity in interpret mode on CPU.
 
-The compiled TPU path is exercised by bench.py (BENCH_PALLAS=1); these tests
-pin the kernel math (forward + custom VJP) against the stock jnp context
-block at float tolerance.
+No CLI flag routes to the kernel (it measures slower than XLA's automatic
+fusion in both train and eval — ablation in ops/pallas_context.py's
+docstring); use ``make_fused_context()`` directly to run the compiled TPU
+path.  These tests pin the kernel math (forward + custom VJP) against the
+stock jnp context block at float tolerance.
 """
 
 import numpy as np
