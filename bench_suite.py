@@ -48,7 +48,7 @@ class SynthVarResDataset:
     the clustered-but-wild histogram real crowd datasets have."""
 
     def __init__(self, n: int, seed: int = 0, lo: int = 384, hi: int = 1024,
-                 dominant=(768, 1024)):
+                 dominant=(768, 1024), u8: bool = False):
         rng = np.random.default_rng(seed)
         self.sizes = []
         for _ in range(n):
@@ -60,7 +60,8 @@ class SynthVarResDataset:
             self.sizes.append(((h // 8) * 8, (w // 8) * 8))
         mh = max(h for h, _ in self.sizes) + 64
         mw = max(w for _, w in self.sizes) + 64
-        self._img_buf = rng.random((mh, mw, 3), dtype=np.float32)
+        img = rng.random((mh, mw, 3), dtype=np.float32)
+        self._img_buf = (img * 255).astype(np.uint8) if u8 else img
         self._dmap_buf = rng.random((mh // 8, mw // 8, 1), dtype=np.float32)
         self._offs = [(int(rng.integers(0, 64)), int(rng.integers(0, 64)))
                       for _ in range(n)]
@@ -118,7 +119,7 @@ def bench_fixed(jnp, compute_dtype, *, b, h, w, steps, warmup=3):
 
 
 def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
-                   lo=384, hi=1024, dominant=(768, 1024)):
+                   lo=384, hi=1024, dominant=(768, 1024), u8=False):
     """The number that predicts real training time: variable-resolution
     images through the full pipeline (bucketing, padding, per-shape
     compiles) into the sharded train step.
@@ -150,7 +151,7 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
 
     ndev = jax.device_count()
     mesh = make_mesh()
-    ds = SynthVarResDataset(n_images, lo=lo, hi=hi, dominant=dominant)
+    ds = SynthVarResDataset(n_images, lo=lo, hi=hi, dominant=dominant, u8=u8)
     batcher = ShardedBatcher(ds, batch * ndev, shuffle=True, seed=0,
                              pad_multiple="auto")
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
@@ -184,7 +185,7 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     dt = time.perf_counter() - t0
     compute_img_per_s = n_imgs * max(1, epochs - 1) / dt
 
-    tag = "f32" if compute_dtype is None else "bf16"
+    tag = ("f32" if compute_dtype is None else "bf16") + ("_u8" if u8 else "")
     _emit(f"train_pipeline_varres_b{batch}_{tag}", compute_img_per_s,
           "images/sec", per_chip=compute_img_per_s / ndev,
           end_to_end_img_per_s=round(s1.img_per_s, 3),
@@ -251,9 +252,12 @@ def main() -> None:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=1, h=128, w=160, steps=4)
             bench_fixed(jnp, None, b=1, h=128, w=160, steps=4)
-        if want("pipeline"):
+        if want("pipeline") or want("u8"):
+            if want("pipeline"):
+                bench_pipeline(jnp, jnp.bfloat16, n_images=16, batch=1,
+                               epochs=2, lo=64, hi=160, dominant=(128, 160))
             bench_pipeline(jnp, jnp.bfloat16, n_images=16, batch=1, epochs=2,
-                           lo=64, hi=160, dominant=(128, 160))
+                           lo=64, hi=160, dominant=(128, 160), u8=True)
         if want("eval"):
             bench_highres_eval(jnp, jnp.bfloat16, h=256, w=256, steps=4)
     else:
@@ -262,6 +266,9 @@ def main() -> None:
             bench_fixed(jnp, None, b=16, h=576, w=768, steps=20)
         if want("pipeline"):
             bench_pipeline(jnp, jnp.bfloat16, n_images=64, batch=8, epochs=3)
+        if want("pipeline") or want("u8"):
+            bench_pipeline(jnp, jnp.bfloat16, n_images=64, batch=8, epochs=3,
+                           u8=True)
         if want("eval"):
             bench_highres_eval(jnp, jnp.bfloat16, h=1536, w=2048, steps=8)
 

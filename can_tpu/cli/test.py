@@ -55,6 +55,10 @@ def parse_args(argv=None):
                    choices=["default", "cpu", "tpu"])
     p.add_argument("--syncBN", action="store_true",
                    help="checkpoint is the BatchNorm model variant")
+    p.add_argument("--u8-input", action="store_true",
+                   help="ship uint8 pixels, normalise on device (see train "
+                        "CLI; pixels differ by u8 resize rounding, so keep "
+                        "the default f32 for bit-exact paper numbers)")
     return p.parse_args(argv)
 
 
@@ -87,7 +91,8 @@ def main(argv=None) -> int:
         compute_dtype = jnp.bfloat16 if args.bf16 else None
 
         img_root, gt_root = dataset_roots(args.data_root, args.split)
-        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test")
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
+                          u8_output=args.u8_input)
         mesh = make_mesh()
         # per-host slice of the lockstep schedule, like the train CLI —
         # without this a multi-host pod would feed every image
@@ -112,7 +117,10 @@ def main(argv=None) -> int:
         if args.show_index is not None:
             from can_tpu.cli.common import make_inference_forward
 
+            from can_tpu.data import normalize_host
+
             img, gt = ds[args.show_index]
+            img = normalize_host(img)  # no-op for the f32 path
             et = make_inference_forward()(params, jnp.asarray(img)[None],
                                           batch_stats)
             paths = save_density_visualization(

@@ -1,5 +1,5 @@
 from .density import gaussian_density_map, generate_density_maps
-from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD
+from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD, normalize_host
 from .batching import ShardedBatcher, Batch, pad_batch
 from .synthetic import make_synthetic_dataset
 from .prefetch import prefetch_to_device
@@ -10,6 +10,7 @@ __all__ = [
     "CrowdDataset",
     "IMAGENET_MEAN",
     "IMAGENET_STD",
+    "normalize_host",
     "ShardedBatcher",
     "Batch",
     "pad_batch",

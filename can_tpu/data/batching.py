@@ -69,10 +69,16 @@ def _ceil_bound(v: int, bounds: Tuple[int, ...]) -> int:
 
 def pad_batch(items, bucket_hw: Tuple[int, int], batch_size: int,
               valid_flags, ds: int) -> Batch:
-    """Assemble variable-size (img, dmap) numpy pairs into one padded Batch."""
+    """Assemble variable-size (img, dmap) numpy pairs into one padded Batch.
+
+    The image buffer keeps the items' dtype: float32 for the normalised
+    host path, uint8 for the device-normalised transfer path (where the
+    step zeroes padded pixels in normalised space via the upsampled
+    pixel_mask, so both paths see identical zero padding)."""
     bh, bw = bucket_hw
     gh, gw = bh // ds, bw // ds
-    image = np.zeros((batch_size, bh, bw, 3), np.float32)
+    img_dtype = items[0][0].dtype if items else np.float32
+    image = np.zeros((batch_size, bh, bw, 3), img_dtype)
     dmap = np.zeros((batch_size, gh, gw, 1), np.float32)
     pixel_mask = np.zeros((batch_size, gh, gw, 1), np.float32)
     sample_mask = np.zeros((batch_size,), np.float32)

@@ -51,15 +51,37 @@ def _read_image(path: str) -> np.ndarray:
     return arr.astype(np.float32)
 
 
+def normalize_host(img: np.ndarray) -> np.ndarray:
+    """u8 (H, W, 3) -> ImageNet-normalised float32 (the host-side twin of
+    train.steps.normalize_on_device, for viz/inference helpers).  Float
+    input (already normalised) passes through unchanged."""
+    if img.dtype != np.uint8:
+        return img
+    return ((img.astype(np.float32) / 255.0 - IMAGENET_MEAN)
+            / IMAGENET_STD).astype(np.float32)
+
+
 class CrowdDataset:
-    """Indexable dataset of (image NHWC, density map (h, w, 1)) numpy pairs."""
+    """Indexable dataset of (image NHWC, density map (h, w, 1)) numpy pairs.
+
+    u8_output=True is the TPU-first transfer mode: images stay uint8 pixels
+    (flip + /8-snap applied, NO normalisation) and the compiled step
+    normalises on device (train/steps.py::normalize_on_device) — 4x fewer
+    host->device bytes, and XLA fuses the normalise into the first conv.
+    The reference ships normalised f32 tensors through its DataLoader
+    (CrowdDataset.py:64-66).  Pixel values differ from the f32 path only by
+    u8 rounding in the resize (<1/255 per pixel); the default stays f32 for
+    bit-exact reference parity.
+    """
 
     def __init__(self, img_root: str, gt_dmap_root: str, *,
-                 gt_downsample: int = 8, phase: str = "train"):
+                 gt_downsample: int = 8, phase: str = "train",
+                 u8_output: bool = False):
         self.img_root = img_root
         self.gt_dmap_root = gt_dmap_root
         self.gt_downsample = int(gt_downsample)
         self.phase = phase
+        self.u8_output = bool(u8_output)
         # sorted (the reference uses os.listdir order, which is fs-dependent;
         # sorting makes sharding identical across hosts)
         self.img_names = sorted(
@@ -104,5 +126,9 @@ class CrowdDataset:
             dmap = cv2.resize(np.ascontiguousarray(dmap), (cols, rows))
             dmap = dmap * ds * ds  # conserve count (reference :61-62)
 
+        dmap = dmap[..., np.newaxis].astype(np.float32)
+        if self.u8_output:
+            # pixels stay bytes; device normalises (see class docstring)
+            return np.clip(np.round(img * 255.0), 0, 255).astype(np.uint8), dmap
         img = (img - IMAGENET_MEAN) / IMAGENET_STD
-        return img.astype(np.float32), dmap[..., np.newaxis].astype(np.float32)
+        return img.astype(np.float32), dmap

@@ -43,6 +43,7 @@ from can_tpu.ops.resize import upsample_matrix
 from can_tpu.ops.separable import separable_hw_contract
 from can_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
 from can_tpu.train.loss import masked_mse_sum
+from can_tpu.train.steps import normalize_on_device
 
 
 def halo_exchange_rows(x: jax.Array, halo: int, axis_name: str,
@@ -224,11 +225,13 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
             if remat:
                 fwd = jax.checkpoint(fwd)
 
+            image = normalize_on_device(batch["image"], batch["pixel_mask"])
+
             def loss_fn(params):
                 if has_bn:
-                    pred, new_stats = fwd(params, batch["image"])
+                    pred, new_stats = fwd(params, image)
                 else:
-                    pred = fwd(params, batch["image"])
+                    pred = fwd(params, image)
                     new_stats = None
                 local_sse = masked_mse_sum(pred, batch)
                 return local_sse / dp, (local_sse, new_stats)
@@ -290,7 +293,8 @@ def make_sp_eval_step(mesh: Mesh, image_hw: Tuple[int, int], *,
     def body(params, batch, batch_stats):
         # eval-mode BN consumes replicated running stats — pointwise per
         # channel, so no extra collective is needed under sp
-        pred = cannet_apply(params, batch["image"], ops=ops,
+        image = normalize_on_device(batch["image"], batch["pixel_mask"])
+        pred = cannet_apply(params, image, ops=ops,
                             compute_dtype=compute_dtype,
                             batch_stats=batch_stats, train=False)
         mask = batch["pixel_mask"] * batch["sample_mask"][:, None, None, None]

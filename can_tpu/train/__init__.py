@@ -1,6 +1,6 @@
 from .loss import masked_mse_sum, density_counts
 from .state import TrainState, create_train_state, make_optimizer, make_lr_schedule
-from .steps import make_train_step, make_eval_step, NonFiniteLossError
+from .steps import make_train_step, make_eval_step, normalize_on_device, NonFiniteLossError
 from .loop import EpochStats, evaluate, train_one_epoch
 
 __all__ = [
@@ -12,6 +12,7 @@ __all__ = [
     "make_lr_schedule",
     "make_train_step",
     "make_eval_step",
+    "normalize_on_device",
     "NonFiniteLossError",
     "train_one_epoch",
     "EpochStats",

@@ -23,6 +23,32 @@ import jax.numpy as jnp
 from can_tpu.train.loss import density_counts, masked_mse_sum
 
 
+def normalize_on_device(image, pixel_mask):
+    """uint8 pixels -> ImageNet-normalised f32, inside the compiled step.
+
+    The TPU-first transfer mode (data/dataset.py u8_output): the host ships
+    bytes (4x less PCIe/tunnel traffic than normalised f32) and XLA fuses
+    this arithmetic into the first conv.  Padded pixels are zeroed in
+    NORMALISED space (via the upsampled pixel_mask — the downsample factor
+    is derived from the image/mask shapes, so any gt_downsample works) so
+    the result is identical to the f32 host path, whose zero padding also
+    lives in normalised space.  Float images pass through untouched.
+    """
+    if image.dtype != jnp.uint8:
+        return image
+    from can_tpu.data.dataset import IMAGENET_MEAN, IMAGENET_STD
+
+    ds = image.shape[-3] // pixel_mask.shape[-3]
+    x = image.astype(jnp.float32) / 255.0
+    x = (x - jnp.asarray(IMAGENET_MEAN)) / jnp.asarray(IMAGENET_STD)
+    m = jnp.repeat(jnp.repeat(pixel_mask, ds, axis=-3), ds, axis=-2)
+    return x * m
+
+
+def _batch_image(batch):
+    return normalize_on_device(batch["image"], batch["pixel_mask"])
+
+
 class NonFiniteLossError(RuntimeError):
     """Raised on NaN/Inf loss.  The reference ``sys.exit(1)``s the observing
     rank while its peers keep waiting in NCCL collectives — a deadlock
@@ -56,11 +82,13 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
         if remat:
             fwd = jax.checkpoint(fwd)
 
+        image = _batch_image(batch)
+
         def loss_fn(params):
             if has_bn:
-                pred, new_stats = fwd(params, batch["image"])
+                pred, new_stats = fwd(params, image)
             else:
-                pred = fwd(params, batch["image"])
+                pred = fwd(params, image)
                 new_stats = None
             sse = masked_mse_sum(pred, batch)
             return sse / grad_divisor, (sse, new_stats)
@@ -90,11 +118,12 @@ def make_eval_step(apply_fn: Callable, *, compute_dtype=None) -> Callable:
     """
 
     def eval_step(params, batch, batch_stats=None):
+        image = _batch_image(batch)
         if batch_stats is not None:
-            pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype,
+            pred = apply_fn(params, image, compute_dtype=compute_dtype,
                             batch_stats=batch_stats, train=False)
         else:
-            pred = apply_fn(params, batch["image"], compute_dtype=compute_dtype)
+            pred = apply_fn(params, image, compute_dtype=compute_dtype)
         et, gt = density_counts(pred, batch)
         err = (et - gt) * batch["sample_mask"]
         return {

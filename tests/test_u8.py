@@ -1,0 +1,151 @@
+"""u8-transfer mode: bytes over the wire, normalisation inside the step.
+
+The TPU-first transfer path (data/dataset.py ``u8_output``,
+train/steps.py ``normalize_on_device``): the host ships uint8 pixels — 4x
+fewer host->device bytes than the reference's normalised-f32 DataLoader
+tensors (reference model/CrowdDataset.py:64-66) — and the compiled step
+normalises, with XLA fusing the arithmetic into the first conv.  These
+tests pin the path's equivalence to the f32 host path: only u8 rounding
+(<=0.5/255 per pixel pre-normalise) may differ, and padding must land on
+exactly 0 in normalised space just like the f32 path's zero fill.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu.data import (
+    CrowdDataset,
+    ShardedBatcher,
+    make_synthetic_dataset,
+    normalize_host,
+    pad_batch,
+)
+from can_tpu.data.dataset import IMAGENET_STD
+from can_tpu.models import cannet_apply, cannet_init
+from can_tpu.parallel import (
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_global_batch,
+    make_mesh,
+)
+from can_tpu.train import (
+    create_train_state,
+    make_lr_schedule,
+    make_optimizer,
+    normalize_on_device,
+)
+
+# u8 quantisation of a pixel moves it by <=0.5/255 before normalisation;
+# after /std (min 0.224) that is <=0.0088
+U8_ATOL = 1e-2
+
+
+@pytest.fixture(scope="module")
+def roots(tmp_path_factory):
+    root = tmp_path_factory.mktemp("u8data")
+    return make_synthetic_dataset(str(root), 6, sizes=((64, 64), (64, 96)),
+                                  seed=11)
+
+
+def _pair(roots, **kw):
+    f32 = CrowdDataset(roots[0], roots[1], gt_downsample=8, phase="test", **kw)
+    u8 = CrowdDataset(roots[0], roots[1], gt_downsample=8, phase="test",
+                      u8_output=True, **kw)
+    return f32, u8
+
+
+class TestU8Dataset:
+    def test_dtypes_and_host_equivalence(self, roots):
+        f32, u8 = _pair(roots)
+        for i in range(len(f32)):
+            img_f, dm_f = f32[i]
+            img_u, dm_u = u8[i]
+            assert img_u.dtype == np.uint8 and img_f.dtype == np.float32
+            np.testing.assert_array_equal(dm_u, dm_f)
+            np.testing.assert_allclose(normalize_host(img_u), img_f,
+                                       atol=U8_ATOL)
+
+    def test_flip_determinism_matches_f32(self, roots):
+        f32 = CrowdDataset(roots[0], roots[1], gt_downsample=8, phase="train")
+        u8 = CrowdDataset(roots[0], roots[1], gt_downsample=8, phase="train",
+                          u8_output=True)
+        for i in range(len(f32)):
+            rng_a = np.random.default_rng((0, 3, i))
+            rng_b = np.random.default_rng((0, 3, i))
+            img_f, dm_f = f32.__getitem__(i, rng=rng_a)
+            img_u, dm_u = u8.__getitem__(i, rng=rng_b)
+            np.testing.assert_array_equal(dm_u, dm_f)  # same flip decision
+            np.testing.assert_allclose(normalize_host(img_u), img_f,
+                                       atol=U8_ATOL)
+
+
+class TestNormalizeOnDevice:
+    def test_matches_f32_batch_and_zero_padding(self, roots):
+        f32, u8 = _pair(roots)
+        items_f = [f32[i] for i in range(4)]
+        items_u = [u8[i] for i in range(4)]
+        bucket = (64, 96)  # pads the (64, 64) items: real padded region
+        bf = pad_batch(items_f, bucket, 4, [True] * 4, 8)
+        bu = pad_batch(items_u, bucket, 4, [True] * 4, 8)
+        assert bu.image.dtype == np.uint8
+        out = np.asarray(normalize_on_device(jnp.asarray(bu.image),
+                                             jnp.asarray(bu.pixel_mask)))
+        np.testing.assert_allclose(out, bf.image, atol=U8_ATOL)
+        # padded pixels: exactly zero in normalised space (as in the f32 path)
+        pad_region = out * (1 - np.repeat(np.repeat(bu.pixel_mask, 8, 1), 8, 2))
+        assert np.abs(pad_region).max() == 0.0
+
+    def test_float_passthrough(self):
+        x = jnp.ones((1, 8, 8, 3), jnp.float32) * 0.5
+        m = jnp.ones((1, 1, 1, 1), jnp.float32)
+        assert normalize_on_device(x, m) is x
+
+
+class TestU8EndToEnd:
+    def test_train_and_eval_steps_match_f32_path(self, roots):
+        mesh = make_mesh(jax.devices()[:8])
+        f32, u8 = _pair(roots)
+        kw = dict(shuffle=False, seed=0, pad_multiple=32)
+        bf = next(iter(ShardedBatcher(f32, 8, **kw).epoch(0)))
+        bu = next(iter(ShardedBatcher(u8, 8, **kw).epoch(0)))
+        assert bu.image.dtype == np.uint8
+
+        params = cannet_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
+        step = make_dp_train_step(cannet_apply, opt, mesh, donate=False)
+        losses = {}
+        for tag, b in (("f32", bf), ("u8", bu)):
+            state = create_train_state(jax.tree.map(jnp.array, params), opt)
+            _, m = step(state, make_global_batch(b, mesh))
+            losses[tag] = float(m["loss"])
+        assert losses["u8"] == pytest.approx(losses["f32"], rel=2e-2)
+
+        ev = make_dp_eval_step(cannet_apply, mesh)
+        mf = jax.device_get(ev(params, make_global_batch(bf, mesh), None))
+        mu = jax.device_get(ev(params, make_global_batch(bu, mesh), None))
+        assert float(mu["abs_err_sum"]) == pytest.approx(
+            float(mf["abs_err_sum"]), rel=2e-2)
+
+    def test_spatial_step_accepts_u8(self, roots):
+        from can_tpu.parallel.spatial import make_sp_eval_step
+
+        mesh = make_mesh(jax.devices()[:8], dp=2, sp=4)
+        mesh_dp = make_mesh(jax.devices()[:8])
+        _, u8 = _pair(roots)
+        b = next(iter(ShardedBatcher(u8, 8, shuffle=False, seed=0,
+                                     pad_multiple=32).epoch(0)))
+        params = cannet_init(jax.random.key(1))
+        h, w = b.image.shape[1:3]
+        ev_sp = make_sp_eval_step(mesh, (h, w))
+        m_sp = jax.device_get(ev_sp(params,
+                                    make_global_batch(b, mesh, spatial=True),
+                                    None))
+        ev_dp = make_dp_eval_step(cannet_apply, mesh_dp)
+        m_dp = jax.device_get(ev_dp(params, make_global_batch(b, mesh_dp),
+                                    None))
+        # identical u8 inputs: sp and dp eval agree to float tolerance
+        assert float(m_sp["abs_err_sum"]) == pytest.approx(
+            float(m_dp["abs_err_sum"]), rel=2e-4)
