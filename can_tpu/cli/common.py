@@ -28,6 +28,26 @@ def parse_pad_multiple(value):
     return int(s)
 
 
+def resolve_sp_padding(pad_multiple, sp: int):
+    """Bucket constraints under spatial parallelism, shared by both CLIs.
+
+    Returns (pad_multiple, min_pad_multiple, min_bucket_h):
+    * bucket H, W must be multiples of 8*sp so max-pool windows never
+      straddle shard boundaries (spatial.py _check_spatial_shapes);
+    * bucket H must be >= 16*sp so each shard owns >= 2 feature rows (the
+      dilated-conv halo) — short images are padded up instead of crashing
+      the step factory mid-eval.
+    """
+    if sp <= 1:
+        return pad_multiple, None, None
+    need = 8 * sp
+    if pad_multiple is None:  # exact shapes can't guarantee divisibility
+        pad_multiple = need
+    elif isinstance(pad_multiple, int) and pad_multiple % need:
+        pad_multiple = -(-pad_multiple // need) * need
+    return pad_multiple, need, 16 * sp
+
+
 def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:
     """ShanghaiTech-style layout (the reference hardcodes these path pairs,
     train.py:49-57): <root>/<split>_data/images + .../ground_truth."""

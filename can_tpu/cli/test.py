@@ -14,14 +14,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from can_tpu.cli.common import dataset_roots, parse_pad_multiple
+from can_tpu.cli.common import (
+    SpatialStepCache,
+    build_mesh_and_batch,
+    dataset_roots,
+    parse_pad_multiple,
+    resolve_sp_padding,
+)
 from can_tpu.data import CrowdDataset, ShardedBatcher
 from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
 from can_tpu.parallel import (
     init_runtime,
     make_dp_eval_step,
     make_global_batch,
-    make_mesh,
     process_count,
     process_index,
     shutdown_runtime,
@@ -38,7 +43,11 @@ def parse_args(argv=None):
     p.add_argument("--epoch", type=int, default=None,
                    help="checkpoint epoch (default: best by MAE, else latest)")
     p.add_argument("--batch-size", type=int, default=1,
-                   help="images per device")
+                   help="images per data-parallel replica")
+    p.add_argument("--sp", type=int, default=1,
+                   help="spatial (image-height) shards per replica — for "
+                        "images too large for one chip (UCF-QNRF scale); "
+                        "forces bucket shapes to multiples of 8*sp")
     p.add_argument("--pad-multiple", type=parse_pad_multiple, default="exact",
                    help="'exact' (default): per-resolution compiles but "
                         "bit-exact boundary math — eval is the parity "
@@ -93,22 +102,37 @@ def main(argv=None) -> int:
         img_root, gt_root = dataset_roots(args.data_root, args.split)
         ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
                           u8_output=args.u8_input)
-        mesh = make_mesh()
         # per-host slice of the lockstep schedule, like the train CLI —
         # without this a multi-host pod would feed every image
         # process_count times
-        local_devices = jax.local_device_count()
-        batcher = ShardedBatcher(ds, args.batch_size * local_devices,
-                                 shuffle=False, pad_multiple=args.pad_multiple,
+        mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
+        pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
+            args.pad_multiple, args.sp)
+        batcher = ShardedBatcher(ds, host_batch, shuffle=False,
+                                 pad_multiple=pad_multiple,
+                                 min_pad_multiple=min_pad,
+                                 min_bucket_h=min_bucket_h,
                                  process_index=process_index(),
                                  process_count=process_count())
         print(f"[data] buckets={batcher.describe_buckets()} -> "
               f"{batcher.distinct_shapes(0)} distinct batch shapes "
               f"(padding overhead {batcher.padding_overhead():.1%})")
-        eval_step = make_dp_eval_step(cannet_apply, mesh,
-                                      compute_dtype=compute_dtype)
+        if args.sp > 1:
+            from can_tpu.parallel.spatial import make_sp_eval_step
+
+            cache = SpatialStepCache(
+                lambda hw: make_sp_eval_step(mesh, hw,
+                                             compute_dtype=compute_dtype))
+
+            def eval_step(p, batch, bstats=None):
+                hw = (batch["image"].shape[1], batch["image"].shape[2])
+                return cache(hw)(p, batch, bstats)
+        else:
+            eval_step = make_dp_eval_step(cannet_apply, mesh,
+                                          compute_dtype=compute_dtype)
         metrics = evaluate(eval_step, params, batcher.epoch(0),
-                           put_fn=lambda b: make_global_batch(b, mesh),
+                           put_fn=lambda b: make_global_batch(
+                               b, mesh, spatial=args.sp > 1),
                            dataset_size=batcher.dataset_size,
                            show_progress=True, batch_stats=batch_stats)
         print(f"[result] images={metrics['num_images']} "

@@ -36,6 +36,7 @@ from can_tpu.cli.common import (
     build_mesh_and_batch,
     dataset_roots,
     parse_pad_multiple,
+    resolve_sp_padding,
 )
 from can_tpu.data import CrowdDataset, ShardedBatcher
 from can_tpu.models import (
@@ -144,19 +145,10 @@ def main(argv=None) -> int:
 
     mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
-    pad_multiple = args.pad_multiple  # parsed by argparse (parse_pad_multiple)
-    min_pad = None
-    if args.sp > 1:
-        # H must divide into sp shards of /8-aligned feature rows, so every
-        # bucket shape has to be a multiple of 8*sp
-        need = 8 * args.sp
-        min_pad = need
-        if pad_multiple is None:
-            pad_multiple = need
-        elif isinstance(pad_multiple, int) and pad_multiple % need:
-            pad_multiple = -(-pad_multiple // need) * need
-        if main_proc and pad_multiple != "auto":
-            print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
+    pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
+        args.pad_multiple, args.sp)
+    if args.sp > 1 and main_proc and pad_multiple != "auto":
+        print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
 
     train_img, train_gt = dataset_roots(args.data_root, "train")
     test_img, test_gt = dataset_roots(args.data_root, "test")
@@ -166,7 +158,7 @@ def main(argv=None) -> int:
                            u8_output=args.u8_input)
     common = dict(seed=args.seed, process_index=process_index(),
                   process_count=process_count(), pad_multiple=pad_multiple,
-                  min_pad_multiple=min_pad)
+                  min_pad_multiple=min_pad, min_bucket_h=min_bucket_h)
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:

@@ -105,7 +105,8 @@ class ShardedBatcher:
     def __init__(self, dataset, batch_size: int, *, shuffle: bool = True,
                  seed: int = 0, process_index: int = 0, process_count: int = 1,
                  pad_multiple=None, ds: int = 8, max_buckets: int = 8,
-                 min_pad_multiple: Optional[int] = None):
+                 min_pad_multiple: Optional[int] = None,
+                 min_bucket_h: Optional[int] = None):
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
@@ -118,6 +119,10 @@ class ShardedBatcher:
         # schedule builds (batches_per_epoch + every epoch) don't re-open
         # every image header
         self._shape_cache: Dict[int, Tuple[int, int]] = {}
+        # floor on bucket height (spatial parallelism: each H-shard must own
+        # >= 2 feature rows, cli/common.py resolve_sp_padding) — callers
+        # pass a value compatible with their pad multiple
+        self.min_bucket_h = min_bucket_h
         self.bucket_ladder: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
         if pad_multiple == "auto":
             pad_multiple = self._resolve_auto_buckets(min_pad_multiple)
@@ -222,11 +227,15 @@ class ShardedBatcher:
     def _bucket_key(self, hw: Tuple[int, int]) -> Tuple[int, int]:
         if self.bucket_ladder is not None:
             hb, wb = self.bucket_ladder
-            return (_ceil_bound(hw[0], hb), _ceil_bound(hw[1], wb))
-        if self.pad_multiple is None:
-            return hw
-        m = self.pad_multiple
-        return (math.ceil(hw[0] / m) * m, math.ceil(hw[1] / m) * m)
+            key = (_ceil_bound(hw[0], hb), _ceil_bound(hw[1], wb))
+        elif self.pad_multiple is None:
+            key = hw
+        else:
+            m = self.pad_multiple
+            key = (math.ceil(hw[0] / m) * m, math.ceil(hw[1] / m) * m)
+        if self.min_bucket_h is not None and key[0] < self.min_bucket_h:
+            key = (self.min_bucket_h, key[1])
+        return key
 
     def global_schedule(self, epoch: int) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
         """Deterministic global batch plan: [(bucket_hw, [(idx, valid)] of

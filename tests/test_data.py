@@ -244,6 +244,26 @@ class TestAutoBucketing:
         assert parse_pad_multiple("64") == 64
         assert parse_pad_multiple(None) is None
 
+    def test_min_bucket_h_clamps_short_images(self):
+        # spatial parallelism: a shard must own >= 2 feature rows, so short
+        # images pad up to min_bucket_h (= 16*sp via resolve_sp_padding)
+        # instead of crashing the sp step factory mid-run
+        ds = _ShapeOnlyDataset(8, seed=5)
+        ds.shapes = [(32, 96)] * 4 + [(128, 96)] * 4
+        b = ShardedBatcher(ds, 4, shuffle=False, pad_multiple=32,
+                           min_bucket_h=64)
+        keys = {b._bucket_key(s) for s in ds.shapes}
+        assert keys == {(64, 96), (128, 96)}
+        assert all(h >= 64 and h % 32 == 0 for h, _ in keys)
+
+    def test_resolve_sp_padding(self):
+        from can_tpu.cli.common import resolve_sp_padding
+
+        assert resolve_sp_padding("auto", 1) == ("auto", None, None)
+        assert resolve_sp_padding(None, 4) == (32, 32, 64)
+        assert resolve_sp_padding(48, 4) == (64, 32, 64)  # rounded to 8*sp
+        assert resolve_sp_padding("auto", 2) == ("auto", 16, 32)
+
 
 class TestPrefetch:
     def test_order_and_completeness(self):
