@@ -31,8 +31,10 @@ def parse_pad_multiple(value):
 def resolve_sp_padding(pad_multiple, sp: int):
     """Bucket constraints under spatial parallelism, shared by both CLIs.
 
-    Returns (pad_multiple, min_pad_multiple, min_bucket_h):
-    * bucket H, W must be multiples of 8*sp so max-pool windows never
+    Returns (pad_multiple, min_pad_multiple, min_bucket_h).  Only the
+    sharded H axis carries sp constraints (spatial.py shards P(data,
+    spatial, None, None)); W keeps the cheaper /8 snap:
+    * bucket H must be a multiple of 8*sp so max-pool windows never
       straddle shard boundaries (spatial.py _check_spatial_shapes);
     * bucket H must be >= 16*sp so each shard owns >= 2 feature rows (the
       dilated-conv halo) — short images are padded up instead of crashing
@@ -42,10 +44,12 @@ def resolve_sp_padding(pad_multiple, sp: int):
         return pad_multiple, None, None
     need = 8 * sp
     if pad_multiple is None:  # exact shapes can't guarantee divisibility
-        pad_multiple = need
-    elif isinstance(pad_multiple, int) and pad_multiple % need:
-        pad_multiple = -(-pad_multiple // need) * need
-    return pad_multiple, need, 16 * sp
+        pad_multiple = (need, 8)
+    elif isinstance(pad_multiple, int):
+        mh = pad_multiple if pad_multiple % need == 0 else (
+            -(-pad_multiple // need) * need)
+        pad_multiple = (mh, pad_multiple)
+    return pad_multiple, (need, None), 16 * sp
 
 
 def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:
@@ -111,3 +115,17 @@ class SpatialStepCache:
         if step is None:
             step = self._steps[image_hw] = self._factory(image_hw)
         return step
+
+
+def make_cached_sp_eval_step(mesh, *, compute_dtype=None):
+    """Bucket-shape-cached spatial eval step (shared by both CLIs)."""
+    from can_tpu.parallel.spatial import make_sp_eval_step
+
+    cache = SpatialStepCache(
+        lambda hw: make_sp_eval_step(mesh, hw, compute_dtype=compute_dtype))
+
+    def eval_step(params, batch, batch_stats=None):
+        hw = (batch["image"].shape[1], batch["image"].shape[2])
+        return cache(hw)(params, batch, batch_stats)
+
+    return eval_step

@@ -15,9 +15,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from can_tpu.cli.common import (
-    SpatialStepCache,
     build_mesh_and_batch,
     dataset_roots,
+    make_cached_sp_eval_step,
     parse_pad_multiple,
     resolve_sp_padding,
 )
@@ -108,6 +108,11 @@ def main(argv=None) -> int:
         mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
         pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
             args.pad_multiple, args.sp)
+        if args.sp > 1 and pad_multiple != args.pad_multiple:
+            # never silently trade away the exact-shape default: sp changes
+            # the reported numbers' boundary math, so say so
+            print(f"[data] sp={args.sp}: bucket H padded to multiples of "
+                  f"{8 * args.sp} (exact shapes can't shard)")
         batcher = ShardedBatcher(ds, host_batch, shuffle=False,
                                  pad_multiple=pad_multiple,
                                  min_pad_multiple=min_pad,
@@ -118,15 +123,8 @@ def main(argv=None) -> int:
               f"{batcher.distinct_shapes(0)} distinct batch shapes "
               f"(padding overhead {batcher.padding_overhead():.1%})")
         if args.sp > 1:
-            from can_tpu.parallel.spatial import make_sp_eval_step
-
-            cache = SpatialStepCache(
-                lambda hw: make_sp_eval_step(mesh, hw,
-                                             compute_dtype=compute_dtype))
-
-            def eval_step(p, batch, bstats=None):
-                hw = (batch["image"].shape[1], batch["image"].shape[2])
-                return cache(hw)(p, batch, bstats)
+            eval_step = make_cached_sp_eval_step(mesh,
+                                                 compute_dtype=compute_dtype)
         else:
             eval_step = make_dp_eval_step(cannet_apply, mesh,
                                           compute_dtype=compute_dtype)
@@ -139,16 +137,43 @@ def main(argv=None) -> int:
               f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
         if args.show_index is not None:
-            from can_tpu.cli.common import make_inference_forward
-
             from can_tpu.data import normalize_host
 
             img, gt = ds[args.show_index]
             img = normalize_host(img)  # no-op for the f32 path
-            et = make_inference_forward()(params, jnp.asarray(img)[None],
-                                          batch_stats)
+            if args.sp > 1 and batch_stats is None:
+                # H-sharded forward — the image may not fit one chip (the
+                # reason --sp was requested); pad H to the sp constraints
+                # and crop the density map back
+                from can_tpu.parallel import make_mesh
+                from can_tpu.parallel.spatial import make_spatial_apply
+
+                h0, w0 = img.shape[:2]
+                need = 8 * args.sp
+                ph = max(-(-h0 // need) * need, 16 * args.sp)
+                pimg = np.zeros((ph, w0, 3), np.float32)
+                pimg[:h0] = img
+                # one image: a dp=1 x sp viz mesh (the eval mesh shards the
+                # batch dim over dp, which a single image can't fill)
+                viz_mesh = make_mesh(jax.devices()[:args.sp], dp=1,
+                                     sp=args.sp)
+                fwd = make_spatial_apply(viz_mesh, (ph, w0),
+                                         compute_dtype=compute_dtype)
+                # params live on the eval mesh; rehome them for the viz mesh
+                host_params = jax.device_get(params)
+                et = np.asarray(fwd(host_params, jnp.asarray(pimg)[None]))[0]
+                et = et[: h0 // 8]
+            else:
+                if args.sp > 1:
+                    print("[viz] note: BN checkpoint -> single-device "
+                          "forward (sp viz has no BN path); may not fit "
+                          "for very large images")
+                from can_tpu.cli.common import make_inference_forward
+
+                et = np.asarray(make_inference_forward()(
+                    params, jnp.asarray(img)[None], batch_stats))[0]
             paths = save_density_visualization(
-                img, gt, np.asarray(et)[0], args.out_dir,
+                img, gt, et, args.out_dir,
                 tag=f"{args.split}_{args.show_index}")
             print(f"[viz] wrote {paths}")
         return 0

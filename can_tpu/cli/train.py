@@ -35,6 +35,7 @@ from can_tpu.cli.common import (
     SpatialStepCache,
     build_mesh_and_batch,
     dataset_roots,
+    make_cached_sp_eval_step,
     parse_pad_multiple,
     resolve_sp_padding,
 )
@@ -212,15 +213,7 @@ def main(argv=None) -> int:
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)
 
-        from can_tpu.parallel.spatial import make_sp_eval_step
-
-        eval_cache = SpatialStepCache(
-            lambda hw: make_sp_eval_step(mesh, hw,
-                                         compute_dtype=compute_dtype))
-
-        def eval_step(params, batch, batch_stats=None):
-            hw = (batch["image"].shape[1], batch["image"].shape[2])
-            return eval_cache(hw)(params, batch, batch_stats)
+        eval_step = make_cached_sp_eval_step(mesh, compute_dtype=compute_dtype)
     else:
         train_step = make_dp_train_step(apply_fn, optimizer, mesh,
                                         compute_dtype=compute_dtype,

@@ -126,10 +126,17 @@ class ShardedBatcher:
         self.bucket_ladder: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
         if pad_multiple == "auto":
             pad_multiple = self._resolve_auto_buckets(min_pad_multiple)
-        if pad_multiple is not None and pad_multiple % self.ds != 0:
-            raise ValueError(
-                f"pad_multiple ({pad_multiple}) must be a multiple of the "
-                f"density downsample factor ({self.ds})")
+        # int -> same multiple both axes; (mh, mw) -> per-axis (spatial
+        # parallelism constrains only the sharded H axis, so W keeps the
+        # cheaper /ds multiple)
+        if isinstance(pad_multiple, int):
+            pad_multiple = (pad_multiple, pad_multiple)
+        if pad_multiple is not None:
+            for m in pad_multiple:
+                if m % self.ds != 0:
+                    raise ValueError(
+                        f"pad_multiple ({pad_multiple}) must be multiples of "
+                        f"the density downsample factor ({self.ds})")
         self.pad_multiple = pad_multiple
 
     def _item_shape(self, idx: int) -> Tuple[int, int]:
@@ -167,10 +174,17 @@ class ShardedBatcher:
         shapes = [self._item_shape(i) for i in range(len(self.dataset))]
         if not shapes:
             return None
-        floor = max(self.ds, int(min_pad_multiple or 0))
-        if floor % self.ds:
-            floor = -(-floor // self.ds) * self.ds
-        if floor == self.ds and len(set(shapes)) <= self.max_buckets:
+        if min_pad_multiple is None or isinstance(min_pad_multiple, int):
+            min_pad_multiple = (min_pad_multiple, min_pad_multiple)
+        floors = []
+        for m in min_pad_multiple:
+            f = max(self.ds, int(m or 0))
+            if f % self.ds:
+                f = -(-f // self.ds) * self.ds
+            floors.append(f)
+        floor_h, floor_w = floors
+        if (floor_h == floor_w == self.ds
+                and len(set(shapes)) <= self.max_buckets):
             return None
         hs = [h for h, _ in shapes]
         ws = [w for _, w in shapes]
@@ -179,8 +193,8 @@ class ShardedBatcher:
             kw = self.max_buckets // kh
             if kw < 1:
                 continue
-            hb = self._axis_bounds(hs, kh, floor)
-            wb = self._axis_bounds(ws, kw, floor)
+            hb = self._axis_bounds(hs, kh, floor_h)
+            wb = self._axis_bounds(ws, kw, floor_w)
             if len(hb) * len(wb) > self.max_buckets:
                 continue
             pad_area = sum(_ceil_bound(h, hb) * _ceil_bound(w, wb)
@@ -188,8 +202,8 @@ class ShardedBatcher:
             if best is None or pad_area < best[0]:
                 best = (pad_area, hb, wb)
         if best is None:  # budget < any grid: one bucket covering the max
-            hb = (-(-max(hs) // floor) * floor,)
-            wb = (-(-max(ws) // floor) * floor,)
+            hb = (-(-max(hs) // floor_h) * floor_h,)
+            wb = (-(-max(ws) // floor_w) * floor_w,)
             best = (0, hb, wb)
         _, hb, wb = best
         self.bucket_ladder = (hb, wb)
@@ -212,7 +226,10 @@ class ShardedBatcher:
             return f"auto ladder H{list(hb)} x W{list(wb)}"
         if self.pad_multiple is None:
             return "exact shapes"
-        return f"multiple of {self.pad_multiple}"
+        mh, mw = self.pad_multiple
+        if mh == mw:
+            return f"multiple of {mh}"
+        return f"H multiple of {mh}, W multiple of {mw}"
 
     def distinct_shapes(self, epoch: int = 0) -> int:
         """Number of distinct bucket shapes in this epoch's schedule — a
@@ -231,8 +248,8 @@ class ShardedBatcher:
         elif self.pad_multiple is None:
             key = hw
         else:
-            m = self.pad_multiple
-            key = (math.ceil(hw[0] / m) * m, math.ceil(hw[1] / m) * m)
+            mh, mw = self.pad_multiple
+            key = (math.ceil(hw[0] / mh) * mh, math.ceil(hw[1] / mw) * mw)
         if self.min_bucket_h is not None and key[0] < self.min_bucket_h:
             key = (self.min_bucket_h, key[1])
         return key

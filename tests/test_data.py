@@ -260,9 +260,17 @@ class TestAutoBucketing:
         from can_tpu.cli.common import resolve_sp_padding
 
         assert resolve_sp_padding("auto", 1) == ("auto", None, None)
-        assert resolve_sp_padding(None, 4) == (32, 32, 64)
-        assert resolve_sp_padding(48, 4) == (64, 32, 64)  # rounded to 8*sp
-        assert resolve_sp_padding("auto", 2) == ("auto", 16, 32)
+        # only H carries sp constraints; W keeps the /8 snap
+        assert resolve_sp_padding(None, 4) == ((32, 8), (32, None), 64)
+        assert resolve_sp_padding(48, 4) == ((64, 48), (32, None), 64)
+        assert resolve_sp_padding("auto", 2) == ("auto", (16, None), 32)
+
+    def test_per_axis_pad_multiple(self):
+        ds = _ShapeOnlyDataset(8, seed=6)
+        ds.shapes = [(200, 968)] * 8
+        b = ShardedBatcher(ds, 4, shuffle=False, pad_multiple=(32, 8))
+        # H rounds to the sp multiple, W keeps its exact /8 snap (no waste)
+        assert b._bucket_key((200, 968)) == (224, 968)
 
 
 class TestPrefetch:
