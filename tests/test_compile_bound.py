@@ -1,0 +1,54 @@
+"""Bounded-compile proof on a wild dataset, end to end (VERDICT r1 item 5).
+
+200 images at random resolutions — the ShanghaiTech-A failure mode where
+exact-shape bucketing would compile one program per resolution and the
+first epoch would look hung — run through the REAL stack (CrowdDataset on
+disk -> ShardedBatcher auto buckets -> prefetch -> jitted dp train step),
+and the epoch must exercise at most ``max_buckets`` distinct batch shapes,
+i.e. at most 8 XLA compilations by construction.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from can_tpu.data import CrowdDataset, ShardedBatcher, make_synthetic_dataset
+from can_tpu.models import cannet_apply, cannet_init
+from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+from can_tpu.train import (
+    create_train_state,
+    make_lr_schedule,
+    make_optimizer,
+    train_one_epoch,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_200_wild_resolutions_compile_at_most_8_programs(tmp_path):
+    rng = np.random.default_rng(9)
+    sizes = [(int(h), int(w)) for h, w in zip(rng.integers(64, 161, 200),
+                                              rng.integers(64, 161, 200))]
+    img_root, gt_root = make_synthetic_dataset(
+        str(tmp_path / "wild"), 200, sizes=tuple(sizes), seed=9,
+        max_people=5)
+    ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="train")
+    batcher = ShardedBatcher(ds, 8, shuffle=True, seed=0, pad_multiple="auto")
+
+    # the failure mode auto bucketing exists to prevent:
+    exact = ShardedBatcher(ds, 8, shuffle=True, seed=0, pad_multiple=None)
+    assert exact.distinct_shapes(0) > 20
+
+    mesh = make_mesh(jax.devices()[:8])
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh)
+    state, stats = train_one_epoch(
+        step, state, batcher.epoch(0),
+        put_fn=lambda b: make_global_batch(b, mesh), show_progress=False)
+
+    assert np.isfinite(float(stats))
+    assert stats.images == 200
+    assert stats.distinct_shapes <= 8  # == compile count of the train step
+    assert batcher.padding_overhead() < 0.5
