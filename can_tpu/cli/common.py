@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import os
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 
@@ -53,8 +53,8 @@ def resolve_sp_padding(pad_multiple, sp: int):
 
 
 def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:
-    """ShanghaiTech-style layout (the reference hardcodes these path pairs,
-    train.py:49-57): <root>/<split>_data/images + .../ground_truth."""
+    """ShanghaiTech-style layout (the reference comments these path pairs,
+    train.py:49-52): <root>/<split>_data/images + .../ground_truth."""
     base = os.path.join(data_root, f"{split}_data")
     img, gt = os.path.join(base, "images"), os.path.join(base, "ground_truth")
     for p in (img, gt):
@@ -63,6 +63,35 @@ def dataset_roots(data_root: str, split: str) -> Tuple[str, str]:
                 f"expected dataset directory {p} (ShanghaiTech layout: "
                 f"<data_root>/{split}_data/{{images,ground_truth}})")
     return img, gt
+
+
+def resolve_split_roots(split: str, image_root: str, gt_root: str,
+                        data_root: str, *,
+                        flag_stem: Optional[str] = None) -> Tuple[str, str]:
+    """Explicit per-split roots (VisDrone-style layouts, where images and
+    density maps live in unrelated trees — the reference hardcodes such a
+    pair, train.py:54-57) win over the ShanghaiTech ``data_root``
+    convention.  Either give BOTH roots for the split, or a data_root.
+
+    flag_stem: prefix of the caller's flags ("train-"/"test-" in the train
+    CLI, "" in the eval CLI) so error messages name flags that exist.
+    Pure argument/isdir checks — call straight after parse_args, before any
+    runtime/checkpoint work.
+    """
+    stem = f"{split}-" if flag_stem is None else flag_stem
+    if image_root or gt_root:
+        if not (image_root and gt_root):
+            raise SystemExit(
+                f"give both --{stem}image-root and --{stem}gt-root "
+                f"(or neither, with --data_root)")
+        for p in (image_root, gt_root):
+            if not os.path.isdir(p):
+                raise FileNotFoundError(f"no such dataset directory: {p}")
+        return image_root, gt_root
+    if not data_root:
+        raise SystemExit(
+            f"need --data_root or --{stem}image-root/--{stem}gt-root")
+    return dataset_roots(data_root, split)
 
 
 def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:

@@ -16,9 +16,9 @@ import numpy as np
 
 from can_tpu.cli.common import (
     build_mesh_and_batch,
-    dataset_roots,
     make_cached_sp_eval_step,
     parse_pad_multiple,
+    resolve_split_roots,
     resolve_sp_padding,
 )
 from can_tpu.data import CrowdDataset, ShardedBatcher
@@ -37,7 +37,13 @@ from can_tpu.utils import CheckpointManager, save_density_visualization
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="CANNet TPU evaluation")
-    p.add_argument("--data_root", type=str, required=True)
+    p.add_argument("--data_root", type=str, default="",
+                   help="ShanghaiTech-layout root "
+                        "(<root>/<split>_data/{images,ground_truth})")
+    p.add_argument("--image-root", type=str, default="",
+                   help="explicit image dir (VisDrone-style layouts); "
+                        "pair with --gt-root")
+    p.add_argument("--gt-root", type=str, default="")
     p.add_argument("--split", type=str, default="test", choices=["train", "test"])
     p.add_argument("--checkpoint-dir", type=str, default="./checkpoints")
     p.add_argument("--epoch", type=int, default=None,
@@ -91,6 +97,10 @@ def load_params(args):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # pure arg/path validation BEFORE runtime init / checkpoint restore
+    img_root, gt_root = resolve_split_roots(
+        args.split, args.image_root, args.gt_root, args.data_root,
+        flag_stem="")
     from can_tpu.cli.train import apply_platform
 
     apply_platform(args)
@@ -98,8 +108,6 @@ def main(argv=None) -> int:
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
-
-        img_root, gt_root = dataset_roots(args.data_root, args.split)
         ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
                           u8_output=args.u8_input)
         # per-host slice of the lockstep schedule, like the train CLI —

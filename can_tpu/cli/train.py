@@ -34,9 +34,9 @@ import numpy as np
 from can_tpu.cli.common import (
     SpatialStepCache,
     build_mesh_and_batch,
-    dataset_roots,
     make_cached_sp_eval_step,
     parse_pad_multiple,
+    resolve_split_roots,
     resolve_sp_padding,
 )
 from can_tpu.data import CrowdDataset, ShardedBatcher
@@ -85,7 +85,15 @@ def parse_args(argv=None):
     p.add_argument("--wandb", action="store_true")
     p.add_argument("--show", action="store_true",
                    help="save eval sample density visualizations")
-    p.add_argument("--data_root", type=str, required=True)
+    p.add_argument("--data_root", type=str, default="",
+                   help="ShanghaiTech-layout root "
+                        "(<root>/<split>_data/{images,ground_truth})")
+    # VisDrone-style layouts: images and density maps in unrelated trees
+    # (the reference hardcodes such a pair, train.py:54-57)
+    p.add_argument("--train-image-root", type=str, default="")
+    p.add_argument("--train-gt-root", type=str, default="")
+    p.add_argument("--test-image-root", type=str, default="")
+    p.add_argument("--test-gt-root", type=str, default="")
     p.add_argument("--init_checkpoint", type=str, default="",
                    help="checkpoint dir to resume from (latest epoch)")
     # TPU-native knobs
@@ -134,6 +142,12 @@ def apply_platform(args) -> None:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # pure arg/path validation BEFORE any runtime init: a typo'd path must
+    # not cost a multi-host rendezvous
+    train_img, train_gt = resolve_split_roots(
+        "train", args.train_image_root, args.train_gt_root, args.data_root)
+    test_img, test_gt = resolve_split_roots(
+        "test", args.test_image_root, args.test_gt_root, args.data_root)
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
@@ -151,8 +165,6 @@ def main(argv=None) -> int:
     if args.sp > 1 and main_proc and pad_multiple != "auto":
         print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
 
-    train_img, train_gt = dataset_roots(args.data_root, "train")
-    test_img, test_gt = dataset_roots(args.data_root, "test")
     train_ds = CrowdDataset(train_img, train_gt, gt_downsample=8,
                             phase="train", u8_output=args.u8_input)
     test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test",

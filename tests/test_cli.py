@@ -88,6 +88,33 @@ class TestTrainCLI:
                           "--out-dir", str(tmp_path / "viz")]) == 0
         assert any(f.endswith(".png") for f in os.listdir(tmp_path / "viz"))
 
+    def test_explicit_split_roots(self, data_root, tmp_path):
+        """VisDrone-style layouts: images and density maps in unrelated
+        trees via explicit per-split roots (reference hardcodes such a
+        pair, train.py:54-57)."""
+        from can_tpu.cli.test import main as test_main
+        from can_tpu.cli.train import main as train_main
+
+        ckdir = str(tmp_path / "ck_roots")
+        argv = ["--train-image-root", os.path.join(data_root, "train_data", "images"),
+                "--train-gt-root", os.path.join(data_root, "train_data", "ground_truth"),
+                "--test-image-root", os.path.join(data_root, "test_data", "images"),
+                "--test-gt-root", os.path.join(data_root, "test_data", "ground_truth"),
+                "--epochs", "1", "--batch-size", "1",
+                "--max-steps-per-epoch", "1",
+                "--checkpoint-dir", ckdir, "--seed", "0"]
+        assert train_main(argv) == 0
+        assert test_main(["--image-root",
+                          os.path.join(data_root, "test_data", "images"),
+                          "--gt-root",
+                          os.path.join(data_root, "test_data", "ground_truth"),
+                          "--checkpoint-dir", ckdir]) == 0
+        # half-specified roots and missing data_root fail fast
+        with pytest.raises(SystemExit, match="both"):
+            train_main(["--train-image-root", "/tmp/x", "--epochs", "1"])
+        with pytest.raises(SystemExit, match="data_root"):
+            train_main(["--epochs", "1"])
+
     def test_spatial_mode_smoke(self, data_root, tmp_path):
         from can_tpu.cli.train import main as train_main
         from can_tpu.cli.test import main as test_main
