@@ -86,7 +86,7 @@ def resolve_split_roots(split: str, image_root: str, gt_root: str,
                 f"(or neither, with --data_root)")
         for p in (image_root, gt_root):
             if not os.path.isdir(p):
-                raise FileNotFoundError(f"no such dataset directory: {p}")
+                raise SystemExit(f"no such dataset directory: {p}")
         return image_root, gt_root
     if not data_root:
         raise SystemExit(

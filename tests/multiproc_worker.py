@@ -18,6 +18,8 @@ Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir> [mode]
 import os
 import sys
 
+import numpy as np
+
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
 
@@ -33,10 +35,12 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
     from can_tpu.parallel import (
+        barrier,
         init_runtime,
         make_dp_train_step,
         make_global_batch,
         make_mesh,
+        reduce_value,
         shutdown_runtime,
     )
     from can_tpu.parallel.spatial import make_sp_train_step
@@ -75,6 +79,14 @@ def main():
         put = lambda b: make_global_batch(b, mesh)
     state, mean_loss = train_one_epoch(step, state, batcher.epoch(0),
                                        put_fn=put, show_progress=False)
+
+    # host-level collectives across REAL processes (reference
+    # distributed_utils.py:28,60-70): barrier + reduce_value
+    barrier("epoch-done")
+    total = float(reduce_value(np.float32(rank + 1), average=False))
+    assert total == sum(r + 1 for r in range(nprocs)), total
+    mean = float(reduce_value(np.float32(rank + 1), average=True))
+    assert abs(mean - total / nprocs) < 1e-6, mean
 
     with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
         f.write(f"{mean_loss:.10g}\n")
