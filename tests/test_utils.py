@@ -1,0 +1,61 @@
+"""Unit tests for the observability utilities (viz, logging).
+
+These are exercised indirectly through the CLI drives (--show /
+--show-index, MetricLogger lines); here their contracts are pinned
+directly: inverse-normalisation round-trips (the reference's 0.255-vs-0.225
+std typo, utils/train_eval_utils.py:92-95, is exactly the bug this would
+catch), file outputs, and logger gating.
+"""
+
+import numpy as np
+
+from can_tpu.data import normalize_host
+from can_tpu.utils import MetricLogger, save_density_visualization
+
+
+class TestViz:
+    def test_writes_three_pngs(self, tmp_path):
+        rng = np.random.default_rng(0)
+        raw = (rng.random((32, 48, 3)) * 255).astype(np.uint8)
+        img = normalize_host(raw)
+        dmap = rng.random((4, 6, 1)).astype(np.float32)
+        paths = save_density_visualization(img, dmap, dmap,
+                                           str(tmp_path), tag="t")
+        assert [p.split("_")[-1] for p in paths] == ["img.png", "gt.png",
+                                                     "et.png"]
+        for p in paths:
+            assert (tmp_path / p.split("/")[-1]).stat().st_size > 0
+
+    def test_inverse_normalisation_roundtrip(self):
+        """normalize_host ∘ un-normalise == identity (catches the
+        reference's per-channel std typo)."""
+        from can_tpu.data import IMAGENET_MEAN, IMAGENET_STD
+
+        rng = np.random.default_rng(1)
+        raw = rng.random((8, 8, 3)).astype(np.float32)
+        normed = (raw - IMAGENET_MEAN) / IMAGENET_STD
+        # the exact inverse viz.py applies before rendering
+        back = normed * IMAGENET_STD + IMAGENET_MEAN
+        np.testing.assert_allclose(back, raw, atol=1e-6)
+
+
+class TestMetricLogger:
+    def test_stdout_lines_and_gating(self, capsys):
+        log = MetricLogger(enabled=True)
+        log.log({"loss": 1.5, "mae": 2.0}, step=3)
+        out = capsys.readouterr().out
+        assert "step 3" in out and "loss=1.5" in out and "mae=2" in out
+        log.finish()
+
+        quiet = MetricLogger(enabled=False)  # non-main processes
+        quiet.log({"loss": 1.0}, step=0)
+        assert capsys.readouterr().out == ""
+        quiet.finish()
+
+    def test_wandb_absent_degrades(self, capsys):
+        # wandb is not installed in this environment: requesting it must
+        # fall back to stdout, not crash (reference hard-requires wandb)
+        log = MetricLogger(enabled=True, use_wandb=True)
+        log.log({"x": 1.0})
+        assert "x=1" in capsys.readouterr().out
+        log.finish()
