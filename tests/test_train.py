@@ -175,6 +175,19 @@ class TestLoops:
                             [random_batch(np.random.default_rng(0))],
                             put_fn=put, show_progress=False)
 
+    def test_lr_schedule_cosine_lrf(self):
+        """The reference parses --lrf but never uses it (train.py:179);
+        here it is a real cosine decay from lr*world to lr*world*lrf."""
+        from can_tpu.train import make_lr_schedule
+
+        const = make_lr_schedule(1e-7, world_size=8)
+        assert float(const(0)) == float(const(1000)) == 8e-7
+
+        sched = make_lr_schedule(1e-7, world_size=8, total_steps=100, lrf=0.1)
+        assert float(sched(0)) == pytest.approx(8e-7)
+        assert float(sched(100)) == pytest.approx(8e-8, rel=1e-5)
+        assert float(sched(50)) == pytest.approx((8e-7 + 8e-8) / 2, rel=1e-2)
+
     def test_epoch_stats_float_compat_and_throughput(self, mesh8):
         from can_tpu.train import EpochStats
 
