@@ -88,6 +88,23 @@ class TestTrainCLI:
                           "--out-dir", str(tmp_path / "viz")]) == 0
         assert any(f.endswith(".png") for f in os.listdir(tmp_path / "viz"))
 
+    def test_syncbn_train_then_eval(self, data_root, tmp_path):
+        """BN-variant end to end through both CLIs: --syncBN trains the
+        real BatchNorm model (running stats checkpointed with the state),
+        and the eval CLI restores the same variant. The reference's flag is
+        a no-op (its model has no BN layers, SURVEY §2); a break anywhere
+        in the batch_stats -> Orbax -> restore chain fails here."""
+        from can_tpu.cli.test import main as test_main
+        from can_tpu.cli.train import main as train_main
+
+        ckdir = str(tmp_path / "ck_bn")
+        argv = ["--data_root", data_root, "--epochs", "1",
+                "--batch-size", "1", "--syncBN",
+                "--checkpoint-dir", ckdir, "--seed", "0"]
+        assert train_main(argv) == 0
+        assert test_main(["--data_root", data_root, "--checkpoint-dir",
+                          ckdir, "--syncBN"]) == 0
+
     def test_explicit_split_roots(self, data_root, tmp_path):
         """VisDrone-style layouts: images and density maps in unrelated
         trees via explicit per-split roots (reference hardcodes such a
