@@ -133,19 +133,23 @@ class TestTrainCLI:
             train_main(["--epochs", "1"])
 
     def test_spatial_mode_smoke(self, data_root, tmp_path):
+        """Maximal flag composition: spatial parallelism x remat x bf16 x
+        u8 transfer, through BOTH CLIs (every advertised capability in one
+        program — no pairwise guards, unlike round 1)."""
         from can_tpu.cli.train import main as train_main
         from can_tpu.cli.test import main as test_main
 
         ckdir = str(tmp_path / "ck_sp")
         argv = ["--data_root", data_root, "--epochs", "1",
-                "--batch-size", "2", "--sp", "4",
-                "--checkpoint-dir", ckdir,
+                "--batch-size", "2", "--sp", "4", "--remat", "--bf16",
+                "--u8-input", "--checkpoint-dir", ckdir,
                 "--max-steps-per-epoch", "1", "--seed", "0"]
         assert train_main(argv) == 0
         # spatial-parallel EVAL through the test CLI (UCF-QNRF config):
         # same checkpoint, H sharded 4-ways per replica
         assert test_main(["--data_root", data_root, "--checkpoint-dir", ckdir,
-                          "--sp", "4", "--batch-size", "2"]) == 0
+                          "--sp", "4", "--batch-size", "2", "--bf16",
+                          "--u8-input"]) == 0
 
 
 def test_step_timer_fences():
