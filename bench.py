@@ -44,6 +44,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from can_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()  # warm driver re-runs skip the ~30 s compile
+
     from can_tpu.models import cannet_apply, cannet_init
     from can_tpu.parallel import (
         make_dp_train_step,

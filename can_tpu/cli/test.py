@@ -74,6 +74,12 @@ def parse_args(argv=None):
                    help="ship uint8 pixels, normalise on device (see train "
                         "CLI; pixels differ by u8 resize rounding, so keep "
                         "the default f32 for bit-exact paper numbers)")
+    p.add_argument("--num-workers", type=int, default=None,
+                   help="host data-loading threads (default: min(8, cpus); "
+                        "0 = main thread)")
+    p.add_argument("--compile-cache", type=str, default="auto",
+                   help="persistent XLA compilation-cache dir ('auto' = "
+                        "~/.cache/can_tpu/xla, 'off' disables)")
     return p.parse_args(argv)
 
 
@@ -101,10 +107,15 @@ def main(argv=None) -> int:
     img_root, gt_root = resolve_split_roots(
         args.split, args.image_root, args.gt_root, args.data_root,
         flag_stem="")
-    from can_tpu.cli.train import apply_platform
+    from can_tpu.cli.train import (
+        apply_compile_cache,
+        apply_platform,
+        resolve_num_workers,
+    )
 
     apply_platform(args)
     init_runtime()
+    apply_compile_cache(args)
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -126,10 +137,14 @@ def main(argv=None) -> int:
                                  min_pad_multiple=min_pad,
                                  min_bucket_h=min_bucket_h,
                                  process_index=process_index(),
-                                 process_count=process_count())
-        print(f"[data] buckets={batcher.describe_buckets()} -> "
-              f"{batcher.distinct_shapes(0)} distinct batch shapes "
-              f"(padding overhead {batcher.padding_overhead():.1%})")
+                                 process_count=process_count(),
+                                 num_workers=resolve_num_workers(args))
+        if process_index() == 0:
+            # main-process-only: the telemetry re-scans every image header,
+            # and a pod would otherwise emit one duplicate line per process
+            print(f"[data] buckets={batcher.describe_buckets()} -> "
+                  f"{batcher.distinct_shapes(0)} distinct batch shapes "
+                  f"(padding overhead {batcher.padding_overhead():.1%})")
         if args.sp > 1:
             eval_step = make_cached_sp_eval_step(mesh,
                                                  compute_dtype=compute_dtype)

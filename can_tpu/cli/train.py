@@ -132,12 +132,36 @@ def parse_args(argv=None):
                    help="force a JAX platform (cpu + "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
                         "gives an N-device virtual mesh)")
+    p.add_argument("--num-workers", type=int, default=None,
+                   help="host data-loading threads per process (decode + "
+                        "resize + pad; the reference's DataLoader "
+                        "num_workers, train.py:90). Default: min(8, cpus); "
+                        "0 = load in the main thread")
+    p.add_argument("--compile-cache", type=str, default="auto",
+                   help="persistent XLA compilation-cache dir ('auto' = "
+                        "~/.cache/can_tpu/xla, 'off' disables): warm "
+                        "restarts skip the per-bucket-shape compile bill")
     return p.parse_args(argv)
 
 
 def apply_platform(args) -> None:
     if args.platform != "default":
         jax.config.update("jax_platforms", args.platform)
+
+
+def apply_compile_cache(args, *, announce: bool = False) -> None:
+    from can_tpu.utils import enable_compilation_cache
+
+    spec = getattr(args, "compile_cache", "auto")
+    cache_dir = enable_compilation_cache(None if spec == "auto" else spec)
+    if announce and cache_dir:
+        print(f"[xla] persistent compilation cache at {cache_dir}")
+
+
+def resolve_num_workers(args) -> int:
+    if getattr(args, "num_workers", None) is not None:
+        return max(0, args.num_workers)
+    return min(8, os.cpu_count() or 1)
 
 
 def main(argv=None) -> int:
@@ -151,6 +175,7 @@ def main(argv=None) -> int:
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
+    apply_compile_cache(args, announce=is_main_process())
     if main_proc:
         print(f"[runtime] {topo}")
         print(f"[start] {datetime.datetime.now():%Y-%m-%d %H:%M:%S}")
@@ -169,14 +194,17 @@ def main(argv=None) -> int:
                             phase="train", u8_output=args.u8_input)
     test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test",
                            u8_output=args.u8_input)
+    num_workers = resolve_num_workers(args)
     common = dict(seed=args.seed, process_index=process_index(),
                   process_count=process_count(), pad_multiple=pad_multiple,
-                  min_pad_multiple=min_pad, min_bucket_h=min_bucket_h)
+                  min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
+                  num_workers=num_workers)
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:
         print(f"[data] train={len(train_ds)} test={len(test_ds)} "
-              f"host_batch={host_batch} dp={dp} sp={args.sp}")
+              f"host_batch={host_batch} dp={dp} sp={args.sp} "
+              f"workers={num_workers}")
         # compile-count telemetry: every distinct bucket shape compiles its
         # own executable, so this number is the first-epoch compile bill
         for tag, b in (("train", train_batcher), ("test", test_batcher)):
@@ -237,7 +265,9 @@ def main(argv=None) -> int:
 
     logger = MetricLogger(use_wandb=args.wandb, enabled=main_proc,
                           name=f"bs{args.batch_size}x{dp}",
-                          config=vars(args))
+                          config=vars(args),
+                          run_id_file=os.path.join(args.checkpoint_dir,
+                                                   "wandb_run_id.txt"))
     best_mae = float("inf")
     try:
         with profile_trace(args.profile_dir or None):
@@ -251,19 +281,27 @@ def main(argv=None) -> int:
                     train_step, state, batches, put_fn=put, epoch=epoch,
                     show_progress=main_proc,
                     total=steps_per_epoch)
+                # every epoch (not only eval epochs): loss, throughput, and
+                # the shape count — a bucketing misconfiguration shows up
+                # here as distinct_shapes churning mid-run
+                epoch_metrics = {
+                    "train_loss": float(mean_loss),
+                    "lr": float(schedule(int(state.step))),
+                    "img_per_s": round(mean_loss.img_per_s, 2),
+                    "epoch_s": round(mean_loss.seconds, 2),
+                    "distinct_shapes": mean_loss.distinct_shapes,
+                }
 
-                if (epoch + 1) % args.eval_interval == 0:
+                eval_epoch = (epoch + 1) % args.eval_interval == 0
+                if eval_epoch:
                     metrics = evaluate(eval_step, state.params,
                                        test_batcher.epoch(0), put_fn=put,
                                        dataset_size=test_batcher.dataset_size,
                                        batch_stats=state.batch_stats)
                     mae = metrics["mae"]
-                    lr_now = float(schedule(int(state.step)))
-                    logger.log({"train_loss": float(mean_loss), "mae": mae,
-                                "mse": metrics["mse"], "lr": lr_now,
-                                "img_per_s": round(mean_loss.img_per_s, 2),
-                                "epoch_s": round(mean_loss.seconds, 2)},
-                               step=epoch)
+                    epoch_metrics.update(mae=mae, mse=metrics["mse"])
+                logger.log(epoch_metrics, step=epoch)
+                if eval_epoch:
                     ckpt.save(epoch, state, mae=mae,
                               extra={"mse": metrics["mse"]})
                     if mae < best_mae:

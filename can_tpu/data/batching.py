@@ -32,8 +32,10 @@ distinct shape is a recompile.  TPU-first design:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -106,9 +108,16 @@ class ShardedBatcher:
                  seed: int = 0, process_index: int = 0, process_count: int = 1,
                  pad_multiple=None, ds: int = 8, max_buckets: int = 8,
                  min_pad_multiple: Optional[int] = None,
-                 min_bucket_h: Optional[int] = None):
+                 min_bucket_h: Optional[int] = None,
+                 num_workers: int = 0):
         self.dataset = dataset
         self.batch_size = int(batch_size)
+        # host loader threads (the reference's DataLoader num_workers,
+        # train.py:90, done with threads: PIL decode / cv2 resize release
+        # the GIL, and threads share the process — no pickling, no fork
+        # hazards next to a live JAX runtime).  0 = main-thread loading.
+        self.num_workers = int(num_workers)
+        self._pool: Optional[ThreadPoolExecutor] = None
         self.shuffle = shuffle
         self.seed = int(seed)
         self.process_index = int(process_index)
@@ -285,15 +294,56 @@ class ShardedBatcher:
         return len(self.global_schedule(epoch))
 
     def epoch(self, epoch: int) -> Iterator[Batch]:
-        """Yield this host's slice of each global batch, in schedule order."""
+        """Yield this host's slice of each global batch, in schedule order.
+
+        With ``num_workers > 0``, item loads (decode + resize + flip) run on
+        a thread pool across a sliding window of upcoming batches — both
+        intra-batch (wide batches) and inter-batch (batch_size=1, the
+        reference's default) parallelism.  Output order and content are
+        identical to the serial path: each item's RNG is keyed on
+        (seed, epoch, idx), so determinism is independent of thread timing.
+        """
         lo = self.process_index * self.batch_size
         hi = lo + self.batch_size
-        for key, group in self.global_schedule(epoch):
-            yield self._materialise(key, group[lo:hi], epoch)
+        schedule = self.global_schedule(epoch)
+        pool = self._ensure_pool()
+        if pool is None:
+            for key, group in schedule:
+                yield self._materialise(key, group[lo:hi], epoch)
+            return
+        # enough batches in flight to keep every worker busy even at
+        # batch_size=1, but bounded so at most `window` decoded batches
+        # wait in host RAM
+        window = max(2, -(-self.num_workers // max(self.batch_size, 1)) + 1)
+        inflight = collections.deque()
+
+        def submit(key, group):
+            futs = [pool.submit(self._load_item, int(idx), epoch)
+                    for idx, _ in group]
+            return key, group, futs
+
+        i = 0
+        while i < len(schedule) or inflight:
+            while i < len(schedule) and len(inflight) < window:
+                key, group = schedule[i]
+                inflight.append(submit(key, group[lo:hi]))
+                i += 1
+            key, group, futs = inflight.popleft()
+            items = [f.result() for f in futs]
+            yield pad_batch(items, key, len(group),
+                            [v for _, v in group], self.ds)
+
+    def _ensure_pool(self) -> Optional[ThreadPoolExecutor]:
+        if self.num_workers > 0 and self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                thread_name_prefix="can_tpu_loader")
+        return self._pool
+
+    def _load_item(self, idx: int, epoch: int):
+        rng = np.random.default_rng((self.seed, epoch, idx))
+        return self.dataset.__getitem__(idx, rng=rng)
 
     def _materialise(self, key, group, epoch: int) -> Batch:
-        items = []
-        for idx, _ in group:
-            rng = np.random.default_rng((self.seed, epoch, int(idx)))
-            items.append(self.dataset.__getitem__(int(idx), rng=rng))
+        items = [self._load_item(int(idx), epoch) for idx, _ in group]
         return pad_batch(items, key, len(group), [v for _, v in group], self.ds)

@@ -91,11 +91,12 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
         steps += 1
         if len(pending) >= max(check_every, 1):
             loss_sum, img_sum = _flush(pending, loss_sum, img_sum,
-                                       check_finite, epoch)
+                                       check_finite, epoch, steps)
             pending = []
             if show_progress and hasattr(it, "set_postfix") and img_sum:
                 it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
-    loss_sum, img_sum = _flush(pending, loss_sum, img_sum, check_finite, epoch)
+    loss_sum, img_sum = _flush(pending, loss_sum, img_sum, check_finite,
+                               epoch, steps)
     seconds = time.perf_counter() - t0
     stats = EpochStats(loss_sum / max(img_sum, 1.0), seconds=seconds,
                        images=img_sum, steps=steps,
@@ -103,16 +104,22 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
     return state, stats
 
 
-def _flush(pending, loss_sum, img_sum, check_finite, epoch):
+def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count):
     """Fetch a window of async step metrics in one device_get."""
+    window = len(pending)
     for metrics in jax.device_get(pending):
         loss = float(metrics["loss"])
         if check_finite and not math.isfinite(loss):
             # every host computes the same replicated loss, so every host
             # raises: a clean global abort, not the reference's one-rank
-            # exit + deadlock.
+            # exit + deadlock.  Detection is windowed (one sync per
+            # check_every steps), so the divergence happened up to
+            # `window` steps before this flush.
             raise NonFiniteLossError(
-                f"non-finite loss {loss} in epoch {epoch}; aborting all hosts")
+                f"non-finite loss {loss} in epoch {epoch}, within the last "
+                f"{window} steps (<= step {step_count}; metric checks are "
+                f"windowed — pass check_every=1 to train_one_epoch to "
+                f"pinpoint); aborting all hosts")
         loss_sum += loss
         img_sum += float(metrics["num_valid"])
     return loss_sum, img_sum

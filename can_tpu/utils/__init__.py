@@ -1,4 +1,5 @@
 from .checkpoint import CheckpointManager
+from .compile_cache import default_cache_dir, enable_compilation_cache
 from .logging import MetricLogger
 from .viz import save_density_visualization
 from .profiling import StepTimer, profile_trace
@@ -9,4 +10,6 @@ __all__ = [
     "save_density_visualization",
     "StepTimer",
     "profile_trace",
+    "enable_compilation_cache",
+    "default_cache_dir",
 ]

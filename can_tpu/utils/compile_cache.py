@@ -1,0 +1,58 @@
+"""Persistent XLA compilation cache (on by default in the CLIs/benches).
+
+The bucketed variable-resolution configs compile one program per bucket
+shape — a 180-200 s bill the eager reference never pays, and without a
+persistent cache it is repaid on EVERY fresh process (resume, eval, every
+restart).  JAX's on-disk compilation cache amortises it to once per
+(machine, jaxlib, topology): warm starts deserialise the executable in
+~100 ms instead of recompiling.
+
+Default location: ``~/.cache/can_tpu/xla`` (override with the
+``CAN_TPU_COMPILE_CACHE`` env var or the CLIs' ``--compile-cache`` flag;
+``off`` disables).  Must be called before the first compilation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_OFF_VALUES = ("off", "none", "0", "disabled")
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "CAN_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "can_tpu", "xla"))
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    cache_dir: directory path; None -> :func:`default_cache_dir`, but only
+    on accelerator backends — XLA:CPU's AOT deserialisation logs a spurious
+    machine-feature-mismatch error per cache hit (and CPU compiles are not
+    the 180 s bill this cache exists to kill), so auto mode skips the CPU
+    backend; pass an explicit directory to force it there.  Any of
+    "off"/"none"/"0" -> disabled (returns None).  Returns the directory in
+    effect, or None when disabled.
+
+    Thresholds are zeroed so every program is cached — the workload's many
+    per-bucket-shape programs each take seconds to compile but can fall
+    under JAX's default minimum-compile-time gate on fast hosts.
+    """
+    import jax
+
+    if cache_dir is None:
+        if jax.default_backend() == "cpu":
+            return None
+        cache_dir = default_cache_dir()
+    if str(cache_dir).strip().lower() in _OFF_VALUES:
+        return None
+
+    cache_dir = os.path.abspath(os.path.expanduser(str(cache_dir)))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return cache_dir

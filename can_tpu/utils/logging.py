@@ -8,21 +8,44 @@ CLI keeps working in air-gapped environments.
 
 from __future__ import annotations
 
+import os
+import uuid
 from typing import Optional
+
+
+def _stable_run_id(run_id_file: str) -> str:
+    """Read (or mint and persist) a wandb run id next to the checkpoints, so
+    a resumed training run continues the SAME wandb run instead of starting
+    a fresh one (the reference always starts fresh, train.py:40-46)."""
+    if os.path.isfile(run_id_file):
+        with open(run_id_file) as f:
+            rid = f.read().strip()
+        if rid:
+            return rid
+    rid = uuid.uuid4().hex[:12]
+    os.makedirs(os.path.dirname(run_id_file) or ".", exist_ok=True)
+    with open(run_id_file, "w") as f:
+        f.write(rid)
+    return rid
 
 
 class MetricLogger:
     def __init__(self, *, use_wandb: bool = False, project: str = "CANNet-tpu",
                  group: str = "tpu-ddp", name: Optional[str] = None,
-                 config: Optional[dict] = None, enabled: bool = True):
+                 config: Optional[dict] = None, enabled: bool = True,
+                 run_id_file: Optional[str] = None):
         self.enabled = enabled
         self._wandb = None
         if enabled and use_wandb:
             try:
                 import wandb
 
+                kwargs = {}
+                if run_id_file:
+                    kwargs = dict(id=_stable_run_id(run_id_file),
+                                  resume="allow")
                 wandb.init(project=project, group=group, name=name,
-                           config=config or {})
+                           config=config or {}, **kwargs)
                 self._wandb = wandb
             except ImportError:
                 print("[logging] wandb not installed; falling back to stdout")

@@ -68,3 +68,32 @@ class TestMetricLogger:
         log.log({"x": 1.0})
         assert "x=1" in capsys.readouterr().out
         log.finish()
+
+
+class TestCompileCache:
+    def test_enable_creates_dir_and_sets_config(self, tmp_path):
+        import jax
+
+        from can_tpu.utils import enable_compilation_cache
+
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            d = tmp_path / "xla_cache"
+            got = enable_compilation_cache(str(d))
+            assert got == str(d)
+            assert d.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(d)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_off_disables(self):
+        from can_tpu.utils import enable_compilation_cache
+
+        assert enable_compilation_cache("off") is None
+        assert enable_compilation_cache("none") is None
+
+    def test_default_dir_env_override(self, monkeypatch, tmp_path):
+        from can_tpu.utils import default_cache_dir
+
+        monkeypatch.setenv("CAN_TPU_COMPILE_CACHE", str(tmp_path))
+        assert default_cache_dir() == str(tmp_path)
