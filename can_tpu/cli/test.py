@@ -164,10 +164,11 @@ def main(argv=None) -> int:
 
             img, gt = ds[args.show_index]
             img = normalize_host(img)  # no-op for the f32 path
-            if args.sp > 1 and batch_stats is None:
+            if args.sp > 1:
                 # H-sharded forward — the image may not fit one chip (the
                 # reason --sp was requested); pad H to the sp constraints
-                # and crop the density map back
+                # and crop the density map back.  BN checkpoints ride along:
+                # eval-mode BN consumes replicated running stats.
                 from can_tpu.parallel import make_mesh
                 from can_tpu.parallel.spatial import make_spatial_apply
 
@@ -184,13 +185,12 @@ def main(argv=None) -> int:
                                          compute_dtype=compute_dtype)
                 # params live on the eval mesh; rehome them for the viz mesh
                 host_params = jax.device_get(params)
-                et = np.asarray(fwd(host_params, jnp.asarray(pimg)[None]))[0]
+                host_stats = (jax.device_get(batch_stats)
+                              if batch_stats is not None else None)
+                et = np.asarray(fwd(host_params, jnp.asarray(pimg)[None],
+                                    host_stats))[0]
                 et = et[: h0 // 8]
             else:
-                if args.sp > 1:
-                    print("[viz] note: BN checkpoint -> single-device "
-                          "forward (sp viz has no BN path); may not fit "
-                          "for very large images")
                 from can_tpu.cli.common import make_inference_forward
 
                 et = np.asarray(make_inference_forward()(

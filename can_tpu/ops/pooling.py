@@ -55,7 +55,26 @@ def adaptive_avg_pool2d(x, output_size):
 def max_pool2d(x, window: int = 2, stride: int = 2):
     """Max pool over NHWC, VALID padding (floor division of odd sizes —
     matches torch.nn.MaxPool2d(kernel_size=2, stride=2), reference
-    model/CANNet.py:112)."""
+    model/CANNet.py:112).
+
+    ABLATION (v5e-1, 576x768 b16 bf16 train step; VERDICT r2 item 5): the
+    step profile charges maxpool-backward (``select_and_scatter``) ~5% of
+    device time, so two replacements were measured against this stock
+    lowering's 95.0-95.2 img/s, interleaved in one process:
+
+    * reshape + ``reduce_max`` (VJP = elementwise compare/scale, no
+      select_and_scatter): 88.5 img/s — the forward reshape over
+      minor-adjacent dims costs more than the backward saves;
+    * ``reduce_window`` forward + custom VJP (repeat-upsample the output,
+      equality mask, tie-count division): 77.1 img/s — the backward's
+      full-resolution mask/count intermediates are pure HBM traffic,
+      ~3x the 5% it tried to reclaim.
+
+    Like the Pallas context kernel (ops/pallas_context.py), the honest
+    conclusion is that XLA's lowering wins: select_and_scatter overlaps
+    with the surrounding conv fusions well enough that removing it from
+    the op list does not remove its time from the step.
+    """
     return lax.reduce_window(
         x,
         -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,

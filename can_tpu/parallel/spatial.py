@@ -146,10 +146,13 @@ def _check_spatial_shapes(h: int, sp: int, ds: int = 8) -> None:
 
 def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
                        compute_dtype=None) -> Callable:
-    """Jitted H-sharded forward: (params, image (N, H, W, 3)) -> density map.
+    """Jitted H-sharded forward:
+    ``(params, image (N, H, W, 3), batch_stats_or_None) -> density map``.
 
     The batch is sharded over ``data`` and H over ``spatial``; output density
-    map keeps the same layout.
+    map keeps the same layout.  BN checkpoints pass their (replicated)
+    running stats — eval-mode BN is pointwise per channel, so the sharded
+    forward needs no extra collective for it.
     """
     sp = mesh.shape[SPATIAL_AXIS]
     h, w = image_hw
@@ -158,13 +161,22 @@ def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
     ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw)
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(), P(DATA_AXIS, SPATIAL_AXIS, None, None)),
+             in_specs=(P(), P(DATA_AXIS, SPATIAL_AXIS, None, None), P()),
              out_specs=P(DATA_AXIS, SPATIAL_AXIS, None, None),
              check_vma=False)
-    def fwd(params, x):
+    def fwd(params, x, batch_stats):
+        if batch_stats is not None:
+            return cannet_apply(params, x, ops=ops,
+                                compute_dtype=compute_dtype,
+                                batch_stats=batch_stats, train=False)
         return cannet_apply(params, x, ops=ops, compute_dtype=compute_dtype)
 
-    return jax.jit(fwd)
+    jitted = jax.jit(fwd)
+
+    def apply(params, x, batch_stats=None):
+        return jitted(params, x, batch_stats)
+
+    return apply
 
 
 def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,

@@ -37,17 +37,19 @@ def main():
     from can_tpu.parallel import (
         barrier,
         init_runtime,
+        make_dp_eval_step,
         make_dp_train_step,
         make_global_batch,
         make_mesh,
         reduce_value,
         shutdown_runtime,
     )
-    from can_tpu.parallel.spatial import make_sp_train_step
+    from can_tpu.parallel.spatial import make_sp_eval_step, make_sp_train_step
     from can_tpu.data import CrowdDataset, ShardedBatcher
     from can_tpu.models import cannet_apply, cannet_init
     from can_tpu.train import (
         create_train_state,
+        evaluate,
         make_lr_schedule,
         make_optimizer,
         train_one_epoch,
@@ -70,15 +72,31 @@ def main():
         batcher = ShardedBatcher(ds, 2, shuffle=True, seed=3,
                                  process_index=rank, process_count=nprocs)
         step = make_sp_train_step(opt, mesh, (64, 64))
+        eval_step = make_sp_eval_step(mesh, (64, 64))
         put = lambda b: make_global_batch(b, mesh, spatial=True)
+        eval_bs = 2
     else:
         mesh = make_mesh()
         batcher = ShardedBatcher(ds, 4, shuffle=True, seed=3,
                                  process_index=rank, process_count=nprocs)
         step = make_dp_train_step(cannet_apply, opt, mesh)
+        eval_step = make_dp_eval_step(cannet_apply, mesh)
         put = lambda b: make_global_batch(b, mesh)
+        eval_bs = 4
     state, mean_loss = train_one_epoch(step, state, batcher.epoch(0),
                                        put_fn=put, show_progress=False)
+
+    # evaluate() across REAL process boundaries: the lockstep eval schedule,
+    # the n_seen == dataset_size guard, and the replicated metric fetch must
+    # all hold when each process only materialises its own slice (the
+    # reference's cross-rank eval reduce, utils/train_eval_utils.py:136)
+    eval_ds = CrowdDataset(os.path.join(out_dir, "data", "images"),
+                           os.path.join(out_dir, "data", "ground_truth"),
+                           gt_downsample=8, phase="test")
+    eval_batcher = ShardedBatcher(eval_ds, eval_bs, shuffle=False,
+                                  process_index=rank, process_count=nprocs)
+    metrics = evaluate(eval_step, state.params, eval_batcher.epoch(0),
+                       put_fn=put, dataset_size=eval_batcher.dataset_size)
 
     # host-level collectives across REAL processes (reference
     # distributed_utils.py:28,60-70): barrier + reduce_value
@@ -90,6 +108,8 @@ def main():
 
     with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
         f.write(f"{mean_loss:.10g}\n")
+    with open(os.path.join(out_dir, f"mae_{rank}.txt"), "w") as f:
+        f.write(f"{metrics['mae']:.10g} {metrics['mse']:.10g}\n")
     shutdown_runtime()
 
 

@@ -104,6 +104,13 @@ class TestTrainCLI:
         assert train_main(argv) == 0
         assert test_main(["--data_root", data_root, "--checkpoint-dir",
                           ckdir, "--syncBN"]) == 0
+        # the case --sp exists for: a BN checkpoint visualized H-sharded
+        # (used to silently fall back to a single-device forward)
+        viz = tmp_path / "viz_bn_sp"
+        assert test_main(["--data_root", data_root, "--checkpoint-dir",
+                          ckdir, "--syncBN", "--sp", "2",
+                          "--show-index", "0", "--out-dir", str(viz)]) == 0
+        assert any(f.endswith(".png") for f in os.listdir(viz))
 
     def test_explicit_split_roots(self, data_root, tmp_path):
         """VisDrone-style layouts: images and density maps in unrelated

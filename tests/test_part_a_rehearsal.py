@@ -1,0 +1,30 @@
+"""Part-A recipe dress rehearsal (VERDICT r2 item 4).
+
+Proves the README's "Reproducing the paper number" chain executes end to
+end with the data as the ONLY missing ingredient: synthetic torchvision
+VGG-16 ``.pth`` -> tools/convert_vgg16.py -> ``--vgg16-npz`` training at
+the (scaled) Part-A shape histogram -> best-MAE checkpoint -> eval CLI.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_recipe_chain_executes_and_improves(tmp_path):
+    from tools.rehearse_part_a import run
+
+    res = run(str(tmp_path / "rehearsal"), epochs=3, scale=0.125,
+              n_train=16, n_test=4, lr=2e-6)
+    assert res["eval_rc"] == 0
+    assert np.isfinite(res["eval_mae"])
+    assert len(res["maes"]) == 3 and np.isfinite(res["maes"]).all()
+    # training through the pretrained-frontend flag path actually learns
+    assert min(res["maes"]) < res["maes"][0]
+    # the eval CLI re-measures the best checkpoint on the same split: it
+    # must reproduce the best recorded MAE (same math, fresh process
+    # state).  abs=6e-4: the CLI prints MAE at 3 decimals, so print
+    # rounding alone contributes up to 5e-4.
+    assert res["eval_mae"] == pytest.approx(res["best_mae"],
+                                            rel=1e-3, abs=6e-4)

@@ -189,3 +189,28 @@ class TestSpatialEval:
                                    rtol=2e-4)
         np.testing.assert_allclose(m_sp["sq_err_sum"], m_dp["sq_err_sum"],
                                    rtol=4e-4)
+
+
+class TestSpatialBNForward:
+    def test_bn_eval_forward_matches_unsharded(self):
+        """BN checkpoints through the H-sharded viz/eval forward: eval-mode
+        BN consumes replicated running stats, so the sharded forward must
+        equal the single-device one (cli/test.py --sp --show-index on a
+        --syncBN checkpoint rides this path)."""
+        from can_tpu.models import init_batch_stats
+
+        bn_params = cannet_init(jax.random.key(1), batch_norm=True)
+        stats = init_batch_stats(bn_params)
+        # perturb the running stats away from init so the test can't pass
+        # by ignoring them
+        stats = jax.tree.map(
+            lambda a: a + 0.1 * np.arange(a.size, dtype=np.float32
+                                          ).reshape(a.shape) / a.size, stats)
+        x = _image(b=2, h=128, w=96, seed=3)
+        want = np.asarray(jax.jit(
+            lambda p, x, s: cannet_apply(p, x, batch_stats=s, train=False)
+        )(bn_params, x, stats))
+        mesh = make_mesh(jax.devices()[:8], dp=2, sp=4)
+        fwd = make_spatial_apply(mesh, (128, 96))
+        got = np.asarray(fwd(bn_params, jnp.asarray(x), stats))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
