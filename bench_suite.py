@@ -162,8 +162,9 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     ndev = jax.device_count()
     mesh = make_mesh()
     ds = SynthVarResDataset(n_images, lo=lo, hi=hi, dominant=dominant, u8=u8)
+    max_buckets = int(os.environ.get("BENCH_SUITE_MAX_BUCKETS", "16"))
     batcher = ShardedBatcher(ds, batch * ndev, shuffle=True, seed=0,
-                             pad_multiple="auto")
+                             pad_multiple="auto", max_buckets=max_buckets)
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
     state = create_train_state(cannet_init(jax.random.key(0)), opt)
     step = make_dp_train_step(cannet_apply, opt, mesh, compute_dtype=compute_dtype)
@@ -219,6 +220,8 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
           transfer_mb_per_batch=round(mb, 1),
           distinct_shapes=s1.distinct_shapes,
           padding_overhead=round(batcher.padding_overhead(), 4),
+          schedule_overhead=round(batcher.schedule_overhead(1), 4),
+          max_buckets=max_buckets,
           buckets=batcher.describe_buckets())
 
 
@@ -258,17 +261,22 @@ def bench_host_pipeline(*, n_images, batch, h=576, w=768, workers=(0, 4, 8),
                 quality=jpeg_quality)
             np.save(os.path.join(gt_dir, f"img_{i:04d}.npy"),
                     rng.random((h, w), np.float32))
-        ds = CrowdDataset(img_dir, gt_dir, gt_downsample=8, phase="train")
-        for wk in workers:
-            batcher = ShardedBatcher(ds, batch, shuffle=True, seed=0,
-                                     pad_multiple="auto", num_workers=wk)
-            list(batcher.epoch(0))  # warm the fs cache / thread pool
-            t0 = time.perf_counter()
-            n_done = sum(b.num_valid for b in batcher.epoch(1))
-            dt = time.perf_counter() - t0
-            _emit(f"host_pipeline_{h}x{w}_b{batch}_w{wk}", n_done / dt,
-                  "images/sec", workers=wk, cpus=os.cpu_count(),
-                  n_images=n_images)
+        for u8 in (False, True):
+            # u8 = the --u8-input transfer mode: flip/resize on bytes, no
+            # host normalise — less float math per item on the host too
+            ds = CrowdDataset(img_dir, gt_dir, gt_downsample=8,
+                              phase="train", u8_output=u8)
+            for wk in workers:
+                batcher = ShardedBatcher(ds, batch, shuffle=True, seed=0,
+                                         pad_multiple="auto", num_workers=wk)
+                list(batcher.epoch(0))  # warm the fs cache / thread pool
+                t0 = time.perf_counter()
+                n_done = sum(b.num_valid for b in batcher.epoch(1))
+                dt = time.perf_counter() - t0
+                tag = "_u8" if u8 else ""
+                _emit(f"host_pipeline_{h}x{w}_b{batch}_w{wk}{tag}",
+                      n_done / dt, "images/sec", workers=wk,
+                      cpus=os.cpu_count(), n_images=n_images)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -137,6 +137,11 @@ def parse_args(argv=None):
                         "resize + pad; the reference's DataLoader "
                         "num_workers, train.py:90). Default: min(8, cpus); "
                         "0 = load in the main thread")
+    p.add_argument("--max-buckets", type=int, default=16,
+                   help="compile budget for --pad-multiple auto: max "
+                        "distinct batch shapes per step. More buckets = "
+                        "less padding; the persistent compilation cache "
+                        "makes the one-time compile bill cheap")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -198,7 +203,7 @@ def main(argv=None) -> int:
     common = dict(seed=args.seed, process_index=process_index(),
                   process_count=process_count(), pad_multiple=pad_multiple,
                   min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
-                  num_workers=num_workers)
+                  num_workers=num_workers, max_buckets=args.max_buckets)
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:
@@ -211,7 +216,8 @@ def main(argv=None) -> int:
             n = b.distinct_shapes(0)
             print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
                   f"{n} distinct batch shapes "
-                  f"(padding overhead {b.padding_overhead():.1%})")
+                  f"(padding overhead {b.padding_overhead():.1%}, "
+                  f"schedule overhead {b.schedule_overhead(0):.1%})")
             if n > 4 * b.max_buckets:
                 print(f"[data] WARNING: {n} shapes will each compile a "
                       f"program; use --pad-multiple auto to bound this")

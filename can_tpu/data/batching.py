@@ -69,6 +69,53 @@ def _ceil_bound(v: int, bounds: Tuple[int, ...]) -> int:
     return bounds[-1]
 
 
+def _merge_partial_groups(partials, gbs: int):
+    """Improvement-only pairwise merging of partial batch groups.
+
+    Every partial group pays for ``gbs`` slots at its bucket shape whatever
+    its fill; on wild datasets with many buckets the dead slots can cost
+    more compute than the padding itself (measured: the bench distribution
+    wastes 2x more pixels in dead slots than in padding at 16 buckets).
+    Repeatedly merge the pair of groups whose union — at the JOIN bucket
+    (elementwise max, so still a ladder grid cell: no new compiles) — costs
+    fewer padded pixels than the two groups separately; stop when no merge
+    improves.  Deterministic: inputs arrive key-sorted and ties pick the
+    lexicographically first pair, so every host computes the same schedule.
+    """
+
+    def cost(key, n_items):
+        return key[0] * key[1] * gbs * (-(-n_items // gbs))
+
+    partials = [(k, list(g)) for k, g in partials]
+    full = []
+    while len(partials) > 1:
+        best = None
+        for i in range(len(partials)):
+            ki, gi = partials[i]
+            for j in range(i + 1, len(partials)):
+                kj, gj = partials[j]
+                join = (max(ki[0], kj[0]), max(ki[1], kj[1]))
+                gain = (cost(ki, len(gi)) + cost(kj, len(gj))
+                        - cost(join, len(gi) + len(gj)))
+                if gain > 0 and (best is None or gain > best[0]):
+                    best = (gain, i, j, join)
+        if best is None:
+            break
+        _, i, j, join = best
+        merged = partials[i][1] + partials[j][1]
+        partials = [p for t, p in enumerate(partials) if t not in (i, j)]
+        # a strictly-improving merge never overflows gbs: for a+b > gbs the
+        # join would cost two batches at >= the average of the two shapes.
+        # Guard the invariant anyway (full batches peel off) so a future
+        # cost-function tweak can't silently emit oversized groups.
+        while len(merged) > gbs:
+            full.append((join, merged[:gbs]))
+            merged = merged[gbs:]
+        if merged:
+            partials.append((join, merged))
+    return full + partials
+
+
 def pad_batch(items, bucket_hw: Tuple[int, int], batch_size: int,
               valid_flags, ds: int) -> Batch:
     """Assemble variable-size (img, dmap) numpy pairs into one padded Batch.
@@ -157,13 +204,49 @@ class ShardedBatcher:
     @staticmethod
     def _axis_bounds(values, k: int, floor: int) -> Tuple[int, ...]:
         """k quantile upper bounds for one axis, rounded up to ``floor``
-        multiples (so every bucket H works under spatial sharding too)."""
+        multiples (so every bucket H works under spatial sharding too) —
+        the coordinate-descent seed."""
         vs = sorted(values)
         n = len(vs)
         bounds = set()
         for i in range(1, k + 1):
             v = vs[-(-i * n // k) - 1]  # ceil(i*n/k)-1: i-th quantile's top
             bounds.add(-(-v // floor) * floor)
+        return tuple(sorted(bounds))
+
+    @staticmethod
+    def _dp_axis_bounds(values, weights, k: int, floor: int) -> Tuple[int, ...]:
+        """EXACT optimal <=k upper bounds for one axis minimising
+        ``sum_i weights[i] * bound(values[i])`` (bounds restricted to
+        ``floor`` multiples of observed values).  O(k n^2) DP over the n
+        distinct candidates, vectorised; n is small (distinct snapped
+        extents)."""
+        cands = sorted({-(-v // floor) * floor for v in values})
+        n = len(cands)
+        if n <= k:
+            return tuple(cands)
+        wsum = {c: 0.0 for c in cands}
+        for v, wt in zip(values, weights):
+            wsum[-(-v // floor) * floor] += float(wt)
+        pre = np.concatenate([[0.0], np.cumsum([wsum[c] for c in cands])])
+        c_arr = np.asarray(cands, dtype=np.float64)
+        inf = np.inf
+        # f[m, j]: min cost covering candidates[0..j] with m bounds, bound at j
+        f = np.full((k + 1, n), inf)
+        f[1] = c_arr * pre[1:]
+        choice = np.zeros((k + 1, n), dtype=np.int64)
+        for m in range(2, k + 1):
+            # cost(i -> j) = f[m-1, i] + c_j * (pre[j+1] - pre[i+1]), i < j
+            prev = f[m - 1][:, None]  # (n, 1) over i
+            trans = prev + c_arr[None, :] * (pre[1:][None, :] - pre[1:][:, None])
+            trans = np.where(np.tri(n, n, -1, dtype=bool).T, trans, inf)
+            choice[m] = np.argmin(trans, axis=0)
+            f[m] = trans[choice[m], np.arange(n)]
+        m_best = int(np.argmin(f[1:, n - 1])) + 1
+        bounds, j, m = [], n - 1, m_best
+        while m >= 1:
+            bounds.append(cands[j])
+            j, m = int(choice[m][j]), m - 1
         return tuple(sorted(bounds))
 
     def _resolve_auto_buckets(self, min_pad_multiple: Optional[int]) -> Optional[int]:
@@ -202,8 +285,21 @@ class ShardedBatcher:
             kw = self.max_buckets // kh
             if kw < 1:
                 continue
+            # seed with quantiles, then coordinate-descend: each axis's
+            # bounds are re-solved EXACTLY (weighted 1-D DP) holding the
+            # other axis fixed — the weight of an item along H is its
+            # current padded W and vice versa, so each pass minimises the
+            # true padded area.  Converges in 2-3 passes.
             hb = self._axis_bounds(hs, kh, floor_h)
             wb = self._axis_bounds(ws, kw, floor_w)
+            for _ in range(3):
+                hb2 = self._dp_axis_bounds(
+                    hs, [_ceil_bound(w, wb) for w in ws], kh, floor_h)
+                wb2 = self._dp_axis_bounds(
+                    ws, [_ceil_bound(h, hb2) for h in hs], kw, floor_w)
+                if (hb2, wb2) == (hb, wb):
+                    break
+                hb, wb = hb2, wb2
             if len(hb) * len(wb) > self.max_buckets:
                 continue
             pad_area = sum(_ceil_bound(h, hb) * _ceil_bound(w, wb)
@@ -227,6 +323,22 @@ class ShardedBatcher:
         item_area = sum(h * w for h, w in shapes)
         bucket_area = sum(bh * bw for bh, bw in map(self._bucket_key, shapes))
         return bucket_area / max(item_area, 1) - 1.0
+
+    def schedule_overhead(self, epoch: int = 0) -> float:
+        """TRUE fraction of step compute wasted in this epoch's schedule:
+        padded pixels AND dead fill slots, over valid item pixels.  (
+        ``padding_overhead`` counts only the per-item padding; on small or
+        wildly-shaped datasets the dead slots of partial batches dominate.)
+        """
+        valid_px = 0
+        used_px = 0
+        for key, group in self.global_schedule(epoch):
+            used_px += key[0] * key[1] * len(group)
+            for idx, valid in group:
+                if valid:
+                    h, w = self._item_shape(idx)
+                    valid_px += h * w
+        return used_px / max(valid_px, 1) - 1.0
 
     def describe_buckets(self) -> str:
         """One-line bucket-policy summary for startup telemetry."""
@@ -282,12 +394,24 @@ class ShardedBatcher:
             if len(group) == gbs:
                 schedule.append((key, group))
                 pending[key] = []
-        for key, group in pending.items():
-            if group:
+        partials = sorted(((k, g) for k, g in pending.items() if g),
+                          key=lambda kg: kg[0])
+        if self.bucket_ladder is not None:
+            # ladder mode only: merge straggler groups upward when that
+            # costs fewer padded pixels than their dead slots would.  Joins
+            # are elementwise maxes of ladder bounds, i.e. grid cells, so
+            # the compile bound holds.  Exact mode skips this (a merge
+            # would break its zero-padding promise); fixed-multiple mode
+            # skips it too — there the join space is the cross product of
+            # observed extents and each epoch's shuffle could mint novel
+            # shapes, i.e. unbounded mid-run compiles.
+            partials = _merge_partial_groups(partials, gbs)
+        for key, group in partials:
+            if len(group) < gbs:
                 # fill dead slots (static shape, zero weight) instead of the
                 # reference's wrap-around duplicates.
                 group = group + [(group[0][0], False)] * (gbs - len(group))
-                schedule.append((key, group))
+            schedule.append((key, group))
         return schedule
 
     def batches_per_epoch(self, epoch: int = 0) -> int:

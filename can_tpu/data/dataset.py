@@ -35,8 +35,8 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
 
 
-def _read_image(path: str) -> np.ndarray:
-    """RGB float32 in [0,1], (H, W, 3)."""
+def _read_image_raw(path: str) -> np.ndarray:
+    """Decoded pixels as stored (u8 for JPEG/8-bit PNG), RGB (H, W, 3)."""
     from PIL import Image
 
     with Image.open(path) as im:
@@ -45,10 +45,30 @@ def _read_image(path: str) -> np.ndarray:
         arr = np.stack([arr] * 3, axis=-1)
     if arr.shape[-1] == 4:  # drop alpha
         arr = arr[..., :3]
+    return arr
+
+
+def _read_image(path: str) -> np.ndarray:
+    """RGB float32 in [0,1], (H, W, 3)."""
+    arr = _read_image_raw(path)
     if np.issubdtype(arr.dtype, np.integer):
         # scale by the dtype's full range (uint8 -> /255, 16-bit PNG -> /65535)
         return arr.astype(np.float32) / float(np.iinfo(arr.dtype).max)
     return arr.astype(np.float32)
+
+
+def _read_image_u8(path: str) -> np.ndarray:
+    """RGB uint8 (H, W, 3) — the zero-float-math decode for u8 mode."""
+    arr = _read_image_raw(path)
+    if arr.dtype == np.uint8:
+        return arr
+    if np.issubdtype(arr.dtype, np.integer):  # e.g. 16-bit PNG
+        # match the f32 path's full-range convention (/iinfo.max): shift
+        # so the dtype's max lands on 255 — signed types have one fewer
+        # value bit, so the shift comes from log2(max+1), not itemsize
+        shift = max(0, int(np.iinfo(arr.dtype).max + 1).bit_length() - 1 - 8)
+        return (arr >> shift).astype(np.uint8)
+    return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
 
 
 def normalize_host(img: np.ndarray) -> np.ndarray:
@@ -65,13 +85,15 @@ class CrowdDataset:
     """Indexable dataset of (image NHWC, density map (h, w, 1)) numpy pairs.
 
     u8_output=True is the TPU-first transfer mode: images stay uint8 pixels
-    (flip + /8-snap applied, NO normalisation) and the compiled step
-    normalises on device (train/steps.py::normalize_on_device) — 4x fewer
-    host->device bytes, and XLA fuses the normalise into the first conv.
-    The reference ships normalised f32 tensors through its DataLoader
+    on the host end to end (u8 decode, u8 flip, cv2 fixed-point u8 resize,
+    NO normalisation) and the compiled step normalises on device
+    (train/steps.py::normalize_on_device) — 4x fewer host->device bytes,
+    XLA fuses the normalise into the first conv, and the host does about
+    half the per-item work (no float conversion/normalise).  The reference
+    ships normalised f32 tensors through its DataLoader
     (CrowdDataset.py:64-66).  Pixel values differ from the f32 path only by
-    u8 rounding in the resize (<1/255 per pixel); the default stays f32 for
-    bit-exact reference parity.
+    u8 rounding in the resize (<~1/255 per pixel); the default stays f32
+    for bit-exact reference parity.
     """
 
     def __init__(self, img_root: str, gt_dmap_root: str, *,
@@ -108,7 +130,13 @@ class CrowdDataset:
     def __getitem__(self, index: int,
                     rng: Optional[np.random.Generator] = None):
         name = self.img_names[index]
-        img = _read_image(os.path.join(self.img_root, name))
+        path = os.path.join(self.img_root, name)
+        # u8 mode keeps pixels as bytes END TO END on the host: u8 decode,
+        # u8 flip, cv2's fixed-point u8 bilinear resize, no normalise —
+        # about half the host work per item of the f32 path (the normalise
+        # runs inside the compiled step instead).  Pixels differ from the
+        # f32 path only by the resize's u8 rounding (<~1/255 per pixel).
+        img = _read_image_u8(path) if self.u8_output else _read_image(path)
         base, _ = os.path.splitext(name)
         dmap = np.load(os.path.join(self.gt_dmap_root, base + ".npy"))
         dmap = np.asarray(dmap, dtype=np.float32)
@@ -121,14 +149,13 @@ class CrowdDataset:
         if ds > 1:
             rows, cols = img.shape[0] // ds, img.shape[1] // ds
             # cv2 bilinear, half-pixel centers — bit-exact with the reference
-            # (CrowdDataset.py:56-60).
+            # (CrowdDataset.py:56-60) on the f32 path.
             img = cv2.resize(np.ascontiguousarray(img), (cols * ds, rows * ds))
             dmap = cv2.resize(np.ascontiguousarray(dmap), (cols, rows))
             dmap = dmap * ds * ds  # conserve count (reference :61-62)
 
         dmap = dmap[..., np.newaxis].astype(np.float32)
         if self.u8_output:
-            # pixels stay bytes; device normalises (see class docstring)
-            return np.clip(np.round(img * 255.0), 0, 255).astype(np.uint8), dmap
+            return img, dmap
         img = (img - IMAGENET_MEAN) / IMAGENET_STD
         return img.astype(np.float32), dmap

@@ -388,3 +388,83 @@ class TestWorkerLoading:
                            num_workers=2)
         with pytest.raises(RuntimeError, match="decode failed"):
             list(b.epoch(0))
+
+
+class TestLadderOptimizer:
+    def test_dp_bounds_beat_or_match_quantiles(self):
+        """The exact DP per axis can never be worse than the quantile seed
+        on its own objective (weighted padded extent)."""
+        rng = np.random.default_rng(7)
+        values = [int(v) * 8 for v in rng.integers(48, 128, 200)]
+        weights = [float(w) for w in rng.uniform(1, 3, 200)]
+        for k in (2, 3, 5):
+            q = ShardedBatcher._axis_bounds(values, k, 8)
+            d = ShardedBatcher._dp_axis_bounds(values, weights, k, 8)
+            assert len(d) <= k
+
+            def cost(bounds):
+                from can_tpu.data.batching import _ceil_bound
+                return sum(w * _ceil_bound(v, bounds)
+                           for v, w in zip(values, weights))
+
+            assert cost(d) <= cost(q) + 1e-6
+            # every value is covered
+            assert max(d) >= max(values)
+
+    def test_dp_bounds_few_distinct(self):
+        b = ShardedBatcher._dp_axis_bounds([64, 64, 128], [1, 1, 1], 5, 8)
+        assert b == (64, 128)
+
+
+class TestStragglerMerging:
+    def _mk(self, keys_and_counts, gbs):
+        from can_tpu.data.batching import _merge_partial_groups
+        partials = [(k, [(i, True) for i in range(n)])
+                    for k, n in keys_and_counts]
+        return _merge_partial_groups(partials, gbs)
+
+    def test_merges_when_cheaper(self):
+        # two half-full groups of similar shape: one merged batch wins
+        out = self._mk([((64, 64), 4), ((64, 72), 4)], 8)
+        assert len(out) == 1
+        key, items = out[0]
+        assert key == (64, 72) and len(items) == 8
+
+    def test_keeps_apart_when_merging_costs_more(self):
+        # a nearly-full small group + nearly-full huge group: merging would
+        # promote 7 small items to the huge shape — more pixels than the
+        # dead slots cost
+        out = self._mk([((64, 64), 7), ((512, 512), 7)], 8)
+        assert sorted(k for k, _ in out) == [(64, 64), (512, 512)]
+
+    def test_equal_cost_merge_skipped(self):
+        # same key, 6+6 over gbs=8: merged or not, the pixel cost is two
+        # batches either way — improvement-only merging leaves them alone
+        # (an overflowing merge can never strictly win: for a+b > gbs the
+        # join costs 2 batches at >= the average shape)
+        out = self._mk([((64, 64), 6), ((64, 64), 6)], 8)
+        assert sorted(len(g) for _, g in out) == [6, 6]
+        # and every emitted group stays within one global batch
+        assert all(len(g) <= 8 for _, g in out)
+
+    def test_never_increases_cost(self):
+        from can_tpu.data.batching import _merge_partial_groups
+        rng = np.random.default_rng(3)
+        for trial in range(20):
+            gbs = int(rng.integers(2, 9))
+            partials = []
+            for i in range(int(rng.integers(2, 7))):
+                k = (int(rng.integers(8, 65)) * 8, int(rng.integers(8, 65)) * 8)
+                n = int(rng.integers(1, gbs))
+                partials.append((k, [(i * 100 + j, True) for j in range(n)]))
+
+            def cost(groups):
+                return sum(k[0] * k[1] * gbs * (-(-len(g) // gbs))
+                           for k, g in groups)
+
+            merged = _merge_partial_groups(sorted(partials), gbs)
+            assert cost(merged) <= cost(partials)
+            # no item lost or duplicated
+            before = sorted(i for _, g in partials for i, _ in g)
+            after = sorted(i for _, g in merged for i, _ in g)
+            assert before == after

@@ -38,9 +38,10 @@ from can_tpu.train import (
     normalize_on_device,
 )
 
-# u8 quantisation of a pixel moves it by <=0.5/255 before normalisation;
-# after /std (min 0.224) that is <=0.0088
-U8_ATOL = 1e-2
+# the u8 path resizes in cv2's fixed-point u8 arithmetic: vs the f32
+# path a pixel moves by <~1/255 before normalisation; after /std
+# (min 0.224) that is <~0.018
+U8_ATOL = 2e-2
 
 
 @pytest.fixture(scope="module")

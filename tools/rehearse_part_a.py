@@ -151,22 +151,29 @@ def run(root: str, *, epochs: int = 3, scale: float = 1.0,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", required=True)
-    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=3,
+                    help="must be >= 2 (the success gate compares later "
+                         "epochs' MAE against the first)")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="shape-histogram scale (0.125 for CPU smoke)")
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu", "tpu"])
     ap.add_argument("--lr", type=float, default=2e-6)
     args = ap.parse_args()
+    if args.epochs < 2:
+        ap.error("--epochs must be >= 2 (the success gate needs a later "
+                 "epoch to compare against the first)")
     res = run(args.root, epochs=args.epochs, scale=args.scale,
               platform=args.platform, lr=args.lr)
     print(f"[rehearsal] eval MAEs per epoch: {res['maes']}")
     print(f"[rehearsal] best-checkpoint eval CLI: rc={res['eval_rc']} "
           f"MAE={res['eval_mae']:.3f}")
-    # the recipe checkpoints/evaluates the BEST epoch, so judge that (the
-    # last epoch may regress on a short noisy rehearsal and that's fine)
+    # the recipe checkpoints/evaluates the BEST epoch, so judge later
+    # epochs against the first (the last alone may regress on a short
+    # noisy rehearsal); strict, so a diverging run can't pass vacuously
+    maes = res["maes"]
     ok = (res["eval_rc"] == 0 and np.isfinite(res["eval_mae"])
-          and res["best_mae"] <= res["maes"][0])
+          and len(maes) > 1 and min(maes[1:]) < maes[0])
     print(f"[rehearsal] {'OK' if ok else 'FAILED'} — recipe chain "
           f"{'executes end to end' if ok else 'broke'}")
     return 0 if ok else 1
