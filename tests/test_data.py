@@ -301,7 +301,17 @@ class TestNativeStamping:
         from can_tpu.data.density import _load_native
 
         if _load_native() is None:
-            _pytest.skip("native library not built (tools/build_native.py)")
+            # build on demand — the toolchain is part of the environment
+            try:
+                import can_tpu.data.density as density_mod
+                from tools.build_native import build
+
+                build(verbose=False)
+                density_mod._native_checked = False  # re-probe after build
+            except Exception as e:  # no compiler: genuinely optional
+                _pytest.skip(f"native library unavailable ({e})")
+        if _load_native() is None:
+            _pytest.skip("native library did not load after build")
         rng = np.random.default_rng(4)
         h, w = 150, 200
         points = np.stack([rng.uniform(-5, w + 5, 120),
