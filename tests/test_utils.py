@@ -97,3 +97,21 @@ class TestCompileCache:
 
         monkeypatch.setenv("CAN_TPU_COMPILE_CACHE", str(tmp_path))
         assert default_cache_dir() == str(tmp_path)
+
+
+class TestStableRunId:
+    def test_minted_then_reused(self, tmp_path):
+        from can_tpu.utils.logging import _stable_run_id
+
+        f = str(tmp_path / "ck" / "wandb_run_id.txt")
+        rid = _stable_run_id(f)
+        assert rid and len(rid) == 12
+        # a resumed run reads the same id back (same wandb run continues)
+        assert _stable_run_id(f) == rid
+
+    def test_empty_file_remints(self, tmp_path):
+        from can_tpu.utils.logging import _stable_run_id
+
+        f = tmp_path / "id.txt"
+        f.write_text("")
+        assert _stable_run_id(str(f))
