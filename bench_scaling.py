@@ -1,0 +1,107 @@
+"""Data-parallel scaling sweep: img/s and efficiency vs chip count.
+
+The north star (BASELINE.json) includes 1->64-chip scaling efficiency; the
+reference's only scaling evidence is "it runs" at world sizes 1/4/6
+(reference README.md:24-26).  This harness measures it properly: for each
+divisor-of-available chip count N it builds an N-device `data` mesh, runs
+the SAME per-chip batch through the jitted dp train step (gradients psum
+over ICI), and reports images/sec plus efficiency vs the 1-chip rate
+(linear scaling == 1.0).
+
+On this dev environment only one real chip is visible, so the sweep
+degenerates to one point there; on a pod slice run it as-is (one process
+per host, same command).  `BENCH_SCALING_PLATFORM=cpu8` demonstrates the
+harness on an 8-device virtual CPU mesh (the numbers then measure CPU
+core contention, not ICI — structural validation only, and it says so).
+
+One JSON line per point:
+  {"metric": "scaling_dp{N}", "value": img/s, "per_chip": ..., "efficiency": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def measure(ndev_use: int, *, b: int, h: int, w: int, steps: int,
+            warmup: int = 3):
+    import jax
+    import jax.numpy as jnp
+
+    from can_tpu.data.batching import Batch
+    from can_tpu.models import cannet_apply, cannet_init
+    from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+    from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+
+    devices = jax.devices()[:ndev_use]
+    mesh = make_mesh(devices)
+    rng = np.random.default_rng(0)
+    local_b = b * ndev_use
+    batch = Batch(
+        image=rng.normal(size=(local_b, h, w, 3)).astype(np.float32),
+        dmap=rng.uniform(size=(local_b, h // 8, w // 8, 1)).astype(np.float32),
+        pixel_mask=np.ones((local_b, h // 8, w // 8, 1), np.float32),
+        sample_mask=np.ones((local_b,), np.float32),
+    )
+    gbatch = make_global_batch(batch, mesh)
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev_use))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh,
+                              compute_dtype=jnp.bfloat16)
+    for _ in range(warmup):
+        state, metrics = step(state, gbatch)
+    float(jax.device_get(metrics["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, gbatch)
+    loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss)
+    return local_b * steps / dt
+
+
+def main() -> None:
+    if os.environ.get("BENCH_SCALING_PLATFORM") == "cpu8":
+        from __graft_entry__ import _ensure_cpu_flags
+
+        _ensure_cpu_flags(8)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax  # noqa: F811
+
+    from can_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    ndev = jax.device_count()
+    cpu = jax.devices()[0].platform == "cpu"
+    quick = bool(os.environ.get("BENCH_SCALING_QUICK")) or cpu
+    b, h, w, steps = (1, 128, 160, 4) if quick else (16, 576, 768, 20)
+    print(f"# bench_scaling devices={ndev} platform="
+          f"{jax.devices()[0].platform} shape={h}x{w} b{b}/chip"
+          + (" (CPU: structural validation only — efficiency here measures"
+               " host core contention, not ICI)" if cpu else ""), flush=True)
+
+    counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= ndev]
+    base = None
+    for n in counts:
+        img_s = measure(n, b=b, h=h, w=w, steps=steps)
+        per_chip = img_s / n
+        if base is None:
+            base = per_chip
+        print(json.dumps({
+            "metric": f"scaling_dp{n}_{h}x{w}_b{b}_bf16",
+            "value": round(img_s, 3),
+            "unit": "images/sec",
+            "per_chip": round(per_chip, 3),
+            "efficiency": round(per_chip / base, 4),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
