@@ -162,7 +162,7 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     ndev = jax.device_count()
     mesh = make_mesh()
     ds = SynthVarResDataset(n_images, lo=lo, hi=hi, dominant=dominant, u8=u8)
-    max_buckets = int(os.environ.get("BENCH_SUITE_MAX_BUCKETS", "16"))
+    max_buckets = int(os.environ.get("BENCH_SUITE_MAX_BUCKETS", "24"))
     batcher = ShardedBatcher(ds, batch * ndev, shuffle=True, seed=0,
                              pad_multiple="auto", max_buckets=max_buckets)
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))

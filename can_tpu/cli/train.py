@@ -137,11 +137,15 @@ def parse_args(argv=None):
                         "resize + pad; the reference's DataLoader "
                         "num_workers, train.py:90). Default: min(8, cpus); "
                         "0 = load in the main thread")
-    p.add_argument("--max-buckets", type=int, default=16,
+    p.add_argument("--max-buckets", type=int, default=24,
                    help="compile budget for --pad-multiple auto: max "
                         "distinct batch shapes per step. More buckets = "
-                        "less padding; the persistent compilation cache "
-                        "makes the one-time compile bill cheap")
+                        "less padding (straggler merging keeps the number "
+                        "of shapes actually compiled well under the "
+                        "budget), and the persistent compilation cache "
+                        "makes the one-time bill cheap. Measured on the "
+                        "bench distribution: 8 -> 41.5, 16 -> 50.4, "
+                        "24 -> 56.3 img/s")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
