@@ -127,7 +127,8 @@ def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count):
 
 def evaluate(eval_step: Callable, params, batches: Iterable, *,
              put_fn: Callable, dataset_size: int, show_progress: bool = False,
-             total: Optional[int] = None, batch_stats=None) -> dict:
+             total: Optional[int] = None, batch_stats=None,
+             check_every: int = 4) -> dict:
     """Dataset MAE and (paper-style) RMSE over the eval set.
 
     eval_step returns global sums (see train/steps.py), so accumulating on
@@ -138,12 +139,30 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
     abs_sum = 0.0
     sq_sum = 0.0
     n_seen = 0.0
+    pending = []  # async per-batch metric trees, fetched in windows
     it = _progress(batches, enabled=show_progress, desc="eval", total=total)
+
+    def flush():
+        nonlocal abs_sum, sq_sum, n_seen
+        for m in jax.device_get(pending):
+            abs_sum += float(m["abs_err_sum"])
+            sq_sum += float(m["sq_err_sum"])
+            n_seen += float(m["num_valid"])
+        pending.clear()
+
     for batch in it:
-        m = jax.device_get(eval_step(params, put_fn(batch), batch_stats))
-        abs_sum += float(m["abs_err_sum"])
-        sq_sum += float(m["sq_err_sum"])
-        n_seen += float(m["num_valid"])
+        # don't fetch per step: each device_get is a host<->device round
+        # trip (expensive on pods/tunnels) and drains the dispatch queue.
+        # Windowed instead (like train_one_epoch): one sync per
+        # ``check_every`` batches.  The window also caps how many
+        # in-flight INPUT batches the dispatch queue can pin in HBM, so
+        # the default stays small (4) — at UCF-QNRF image sizes each
+        # staged batch is hundreds of MB; raise it for small-image evals
+        # where the round trips dominate.
+        pending.append(eval_step(params, put_fn(batch), batch_stats))
+        if len(pending) >= max(check_every, 1):
+            flush()
+    flush()
     if int(n_seen) != dataset_size:
         raise RuntimeError(
             f"eval saw {int(n_seen)} valid samples, expected {dataset_size}")
