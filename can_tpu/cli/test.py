@@ -142,9 +142,15 @@ def main(argv=None) -> int:
         if process_index() == 0:
             # main-process-only: the telemetry re-scans every image header,
             # and a pod would otherwise emit one duplicate line per process
+            sched = batcher.schedule_overhead(0)
             print(f"[data] buckets={batcher.describe_buckets()} -> "
                   f"{batcher.distinct_shapes(0)} distinct batch shapes "
-                  f"(padding overhead {batcher.padding_overhead():.1%})")
+                  f"(padding overhead {batcher.padding_overhead():.1%}, "
+                  f"schedule overhead {sched:.1%})")
+            if sched > 0.5:
+                print("[data] hint: most batch slots are fill (small eval "
+                      "set across many shapes at this batch size) — a "
+                      "smaller --batch-size will evaluate faster")
         if args.sp > 1:
             eval_step = make_cached_sp_eval_step(mesh,
                                                  compute_dtype=compute_dtype)

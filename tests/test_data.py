@@ -479,3 +479,37 @@ class TestStragglerMerging:
             before = sorted(i for _, g in partials for i, _ in g)
             after = sorted(i for _, g in merged for i, _ in g)
             assert before == after
+
+
+class TestScheduleOverhead:
+    class _DS:
+        def __init__(self, sizes):
+            self.sizes = sizes
+
+        def __len__(self):
+            return len(self.sizes)
+
+        def snapped_shape(self, i):
+            return self.sizes[i]
+
+        def __getitem__(self, i, rng=None):
+            h, w = self.sizes[i]
+            return (np.zeros((h, w, 3), np.float32),
+                    np.zeros((h // 8, w // 8, 1), np.float32))
+
+    def test_zero_when_full_uniform_batches(self):
+        b = ShardedBatcher(self._DS([(64, 64)] * 8), 4, shuffle=False)
+        assert b.schedule_overhead(0) == 0.0
+
+    def test_counts_dead_slots_exact_mode(self):
+        # one item in a batch of 4: 3 fill slots -> 3x the valid pixels
+        b = ShardedBatcher(self._DS([(64, 64)]), 4, shuffle=False)
+        assert b.schedule_overhead(0) == pytest.approx(3.0)
+
+    def test_ladder_merging_reduces_it(self):
+        sizes = [(64 + 8 * (i % 6), 64 + 8 * (i % 4)) for i in range(24)]
+        unmerged = ShardedBatcher(self._DS(sizes), 4, shuffle=False,
+                                  pad_multiple=None)
+        merged = ShardedBatcher(self._DS(sizes), 4, shuffle=False,
+                                pad_multiple="auto", max_buckets=6)
+        assert merged.schedule_overhead(0) < unmerged.schedule_overhead(0)
