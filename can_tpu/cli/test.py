@@ -143,14 +143,19 @@ def main(argv=None) -> int:
             # main-process-only: the telemetry re-scans every image header,
             # and a pod would otherwise emit one duplicate line per process
             sched = batcher.schedule_overhead(0)
+            pad = batcher.padding_overhead()
             print(f"[data] buckets={batcher.describe_buckets()} -> "
                   f"{batcher.distinct_shapes(0)} distinct batch shapes "
-                  f"(padding overhead {batcher.padding_overhead():.1%}, "
+                  f"(padding overhead {pad:.1%}, "
                   f"schedule overhead {sched:.1%})")
-            if sched > 0.5:
-                print("[data] hint: most batch slots are fill (small eval "
-                      "set across many shapes at this batch size) — a "
-                      "smaller --batch-size will evaluate faster")
+            # fill-slot component alone (schedule_overhead also contains
+            # per-item padding, which a smaller batch would NOT fix)
+            fill = (1 + sched) / (1 + pad) - 1
+            if fill > 0.5:
+                print(f"[data] hint: batch fill slots add {fill:.0%} "
+                      "compute (small eval set spread over many shapes at "
+                      "this batch size) — a smaller --batch-size will "
+                      "evaluate faster")
         if args.sp > 1:
             eval_step = make_cached_sp_eval_step(mesh,
                                                  compute_dtype=compute_dtype)

@@ -482,34 +482,27 @@ class TestStragglerMerging:
 
 
 class TestScheduleOverhead:
-    class _DS:
-        def __init__(self, sizes):
-            self.sizes = sizes
-
-        def __len__(self):
-            return len(self.sizes)
-
-        def snapped_shape(self, i):
-            return self.sizes[i]
-
-        def __getitem__(self, i, rng=None):
-            h, w = self.sizes[i]
-            return (np.zeros((h, w, 3), np.float32),
-                    np.zeros((h // 8, w // 8, 1), np.float32))
+    # schedule_overhead only touches the schedule-facing API, so the
+    # shared _ShapeOnlyDataset stand-in serves (shapes assigned directly)
+    @staticmethod
+    def _ds(sizes):
+        ds = _ShapeOnlyDataset(0)
+        ds.shapes = list(sizes)
+        return ds
 
     def test_zero_when_full_uniform_batches(self):
-        b = ShardedBatcher(self._DS([(64, 64)] * 8), 4, shuffle=False)
+        b = ShardedBatcher(self._ds([(64, 64)] * 8), 4, shuffle=False)
         assert b.schedule_overhead(0) == 0.0
 
     def test_counts_dead_slots_exact_mode(self):
         # one item in a batch of 4: 3 fill slots -> 3x the valid pixels
-        b = ShardedBatcher(self._DS([(64, 64)]), 4, shuffle=False)
+        b = ShardedBatcher(self._ds([(64, 64)]), 4, shuffle=False)
         assert b.schedule_overhead(0) == pytest.approx(3.0)
 
     def test_ladder_merging_reduces_it(self):
         sizes = [(64 + 8 * (i % 6), 64 + 8 * (i % 4)) for i in range(24)]
-        unmerged = ShardedBatcher(self._DS(sizes), 4, shuffle=False,
+        unmerged = ShardedBatcher(self._ds(sizes), 4, shuffle=False,
                                   pad_multiple=None)
-        merged = ShardedBatcher(self._DS(sizes), 4, shuffle=False,
+        merged = ShardedBatcher(self._ds(sizes), 4, shuffle=False,
                                 pad_multiple="auto", max_buckets=6)
         assert merged.schedule_overhead(0) < unmerged.schedule_overhead(0)
