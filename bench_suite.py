@@ -163,8 +163,12 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     mesh = make_mesh()
     ds = SynthVarResDataset(n_images, lo=lo, hi=hi, dominant=dominant, u8=u8)
     max_buckets = int(os.environ.get("BENCH_SUITE_MAX_BUCKETS", "24"))
+    # remnant sub-batches on by default (the CLI default); quantum = ndev so
+    # every sub-batch still splits across the dp mesh axis
+    remnant = not os.environ.get("BENCH_SUITE_NO_REMNANT")
     batcher = ShardedBatcher(ds, batch * ndev, shuffle=True, seed=0,
-                             pad_multiple="auto", max_buckets=max_buckets)
+                             pad_multiple="auto", max_buckets=max_buckets,
+                             remnant_sizes=remnant, batch_quantum=ndev)
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
     state = create_train_state(cannet_init(jax.random.key(0)), opt)
     step = make_dp_train_step(cannet_apply, opt, mesh, compute_dtype=compute_dtype)
@@ -219,9 +223,11 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
           warm_compile_epoch_s=warm_compile_epoch_s,
           transfer_mb_per_batch=round(mb, 1),
           distinct_shapes=s1.distinct_shapes,
+          programs=batcher.program_count(1),
           padding_overhead=round(batcher.padding_overhead(), 4),
           schedule_overhead=round(batcher.schedule_overhead(1), 4),
           max_buckets=max_buckets,
+          remnant_batches=remnant,
           buckets=batcher.describe_buckets())
 
 

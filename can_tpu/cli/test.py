@@ -80,6 +80,13 @@ def parse_args(argv=None):
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables)")
+    p.add_argument("--max-buckets", type=int, default=24,
+                   help="compile budget for --pad-multiple auto (distinct "
+                        "(shape x batch-size) programs)")
+    p.add_argument("--no-remnant-batches", action="store_true",
+                   help="with --pad-multiple auto, pad straggler groups to "
+                        "the full batch instead of emitting smaller "
+                        "sub-batches (see train CLI)")
     return p.parse_args(argv)
 
 
@@ -132,13 +139,18 @@ def main(argv=None) -> int:
             # the reported numbers' boundary math, so say so
             print(f"[data] sp={args.sp}: bucket H padded to multiples of "
                   f"{8 * args.sp} (exact shapes can't shard)")
+        import math as _math
+
         batcher = ShardedBatcher(ds, host_batch, shuffle=False,
                                  pad_multiple=pad_multiple,
                                  min_pad_multiple=min_pad,
                                  min_bucket_h=min_bucket_h,
                                  process_index=process_index(),
                                  process_count=process_count(),
-                                 num_workers=resolve_num_workers(args))
+                                 num_workers=resolve_num_workers(args),
+                                 max_buckets=args.max_buckets,
+                                 remnant_sizes=not args.no_remnant_batches,
+                                 batch_quantum=_math.lcm(dp, process_count()))
         if process_index() == 0:
             # main-process-only: the telemetry re-scans every image header,
             # and a pod would otherwise emit one duplicate line per process

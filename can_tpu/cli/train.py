@@ -146,6 +146,13 @@ def parse_args(argv=None):
                         "makes the one-time bill cheap. Measured on the "
                         "bench distribution: 8 -> 41.5, 16 -> 50.4, "
                         "24 -> 56.3 img/s")
+    p.add_argument("--no-remnant-batches", action="store_true",
+                   help="disable remnant sub-batches: with --pad-multiple "
+                        "auto, straggler groups normally run at a small "
+                        "menu of static sub-batch sizes (near-zero dead "
+                        "slots; each (shape x size) program counts against "
+                        "--max-buckets) instead of padding to the full "
+                        "global batch")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -204,10 +211,17 @@ def main(argv=None) -> int:
     test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test",
                            u8_output=args.u8_input)
     num_workers = resolve_num_workers(args)
+    import math as _math
+
+    # legal remnant sub-batch sizes must split evenly across hosts AND
+    # across the mesh's dp axis (make_global_batch shards the leading dim)
+    quantum = _math.lcm(dp, process_count())
     common = dict(seed=args.seed, process_index=process_index(),
                   process_count=process_count(), pad_multiple=pad_multiple,
                   min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
-                  num_workers=num_workers, max_buckets=args.max_buckets)
+                  num_workers=num_workers, max_buckets=args.max_buckets,
+                  remnant_sizes=not args.no_remnant_batches,
+                  batch_quantum=quantum)
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:
@@ -219,7 +233,8 @@ def main(argv=None) -> int:
         for tag, b in (("train", train_batcher), ("test", test_batcher)):
             n = b.distinct_shapes(0)
             print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
-                  f"{n} distinct batch shapes "
+                  f"{n} distinct batch shapes, "
+                  f"{b.program_count(0)} (shape x size) programs "
                   f"(padding overhead {b.padding_overhead():.1%}, "
                   f"schedule overhead {b.schedule_overhead(0):.1%})")
             if n > 4 * b.max_buckets:
@@ -233,6 +248,13 @@ def main(argv=None) -> int:
         if main_proc:
             print(f"[init] loaded pretrained VGG-16 frontend from {args.vgg16_npz}")
 
+    # the epoch-0 count is exact for EVERY epoch: an item's bucket cell is a
+    # pure function of its shape, so per-cell counts — hence full batches,
+    # straggler merging, and the remnant plan — cannot vary with the
+    # shuffle (pinned by tests/test_data.py
+    # test_schedule_is_epoch_invariant_in_length_and_shapes and
+    # test_lr_schedule_covers_actual_steps), so the cosine schedule's
+    # endpoint lands exactly on the last step
     steps_per_epoch = train_batcher.batches_per_epoch(0)
     schedule = make_lr_schedule(args.lr, world_size=dp,
                                 total_steps=args.epochs * steps_per_epoch,

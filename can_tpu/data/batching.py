@@ -156,9 +156,22 @@ class ShardedBatcher:
                  pad_multiple=None, ds: int = 8, max_buckets: int = 8,
                  min_pad_multiple: Optional[int] = None,
                  min_bucket_h: Optional[int] = None,
-                 num_workers: int = 0):
+                 num_workers: int = 0,
+                 remnant_sizes: bool = False,
+                 batch_quantum: Optional[int] = None):
         self.dataset = dataset
         self.batch_size = int(batch_size)
+        # remnant sub-batches (ladder mode only): emit partial groups at a
+        # small menu of sub-batch sizes instead of padding every straggler
+        # group to the full global batch — see _partial_plan.  Off by
+        # default because legal sub-sizes depend on topology the batcher
+        # can't see: every emitted global batch must divide by the mesh's
+        # dp axis AND by process_count, which is what ``batch_quantum``
+        # (global-batch units; callers pass lcm(dp, process_count))
+        # promises.  The CLIs/bench enable it with the right quantum.
+        self.remnant_sizes = bool(remnant_sizes)
+        self.batch_quantum = int(batch_quantum or process_count or 1)
+        self._plan_cache = None
         # host loader threads (the reference's DataLoader num_workers,
         # train.py:90, done with threads: PIL decode / cv2 resize release
         # the GIL, and threads share the process — no pickling, no fork
@@ -194,6 +207,17 @@ class ShardedBatcher:
                         f"pad_multiple ({pad_multiple}) must be multiples of "
                         f"the density downsample factor ({self.ds})")
         self.pad_multiple = pad_multiple
+        if self.remnant_sizes:
+            gbs = self.batch_size * self.process_count
+            if self.batch_quantum % self.process_count:
+                raise ValueError(
+                    f"batch_quantum ({self.batch_quantum}) must be a multiple "
+                    f"of process_count ({self.process_count}) so every host "
+                    f"slices an equal share of each sub-batch")
+            if gbs % self.batch_quantum:
+                raise ValueError(
+                    f"global batch ({gbs}) must be a multiple of "
+                    f"batch_quantum ({self.batch_quantum})")
 
     def _item_shape(self, idx: int) -> Tuple[int, int]:
         hw = self._shape_cache.get(idx)
@@ -375,6 +399,152 @@ class ShardedBatcher:
             key = (self.min_bucket_h, key[1])
         return key
 
+    def _remnant_menu(self) -> Tuple[int, ...]:
+        """Legal sub-batch sizes (global units), descending: the full global
+        batch plus quantum * 2^j halvings.  Every size divides cleanly into
+        per-host slices and dp shards (batch_quantum contract)."""
+        gbs = self.batch_size * self.process_count
+        menu = {gbs}
+        s = self.batch_quantum
+        while s < gbs:
+            menu.add(s)
+            s *= 2
+        return tuple(sorted(menu, reverse=True))
+
+    @staticmethod
+    def _decompose(n: int, menu: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Cover ``n`` items with menu-size parts minimising (total slots,
+        launch count) — exact tiny DP (n is at most a few global batches).
+        Deterministic; parts returned descending, so any fill slots land in
+        the final (smallest) part."""
+        memo = {}
+
+        def f(r):
+            if r <= 0:
+                return (0, 0, ())
+            got = memo.get(r)
+            if got is None:
+                got = memo[r] = min(
+                    (s + sub[0], 1 + sub[1], (s,) + sub[2])
+                    for s in menu
+                    for sub in (f(r - s),))
+            return got
+
+        return tuple(sorted(f(n)[2], reverse=True))
+
+    def _partial_plan(self):
+        """Epoch-invariant remnant plan for ladder mode.
+
+        An item's bucket cell is a pure function of its shape, so each
+        cell's item count — hence each cell's partial-group size
+        (count mod gbs) — is identical in every epoch; only WHICH items
+        are left over varies with the shuffle.  The plan can therefore be
+        computed once from the shape histogram:
+
+        * each cell's remainder decomposes into menu sub-batch sizes
+          (near-zero fill) instead of padding to the full global batch —
+          the dead-slot waste the round-3 telemetry measured at ~11% of
+          step compute on the bench distribution;
+        * every distinct (bucket shape, batch size) pair is one XLA
+          program, so the plan merges the cheapest pair of partial groups
+          (at the elementwise-max join cell — still a ladder grid cell)
+          until the TOTAL program count — full-batch shapes plus remnant
+          parts — fits ``max_buckets``, and also whenever a merge strictly
+          reduces scheduled pixels (possible when quantum > 1 leaves fill).
+
+        Returns ``(plan, programs)`` where plan is
+        ``[(join_key, (source_keys...), (part_sizes...))]`` sorted by key
+        and programs is the set of (key, size) pairs the whole schedule
+        compiles.  Deterministic: counts come from the sorted dataset
+        listing and ties pick the first candidate pair in sorted order, so
+        every host computes the same plan.
+        """
+        if self._plan_cache is not None:
+            return self._plan_cache
+        gbs = self.batch_size * self.process_count
+        menu = self._remnant_menu()
+        counts = collections.Counter(
+            self._bucket_key(self._item_shape(i))
+            for i in range(len(self.dataset)))
+        full_programs = {(k, gbs) for k, c in counts.items() if c >= gbs}
+        groups = sorted((k, c % gbs, (k,))
+                        for k, c in counts.items() if c % gbs)
+
+        def cost(key, count, m=None):
+            return key[0] * key[1] * sum(self._decompose(count, m or menu))
+
+        def total_cost(gs, m=None):
+            return sum(cost(k, c, m) for k, c, _ in gs)
+
+        def programs(gs, m=None):
+            ps = set(full_programs)
+            for k, c, _ in gs:
+                ps.update((k, s) for s in self._decompose(c, m or menu))
+            return ps
+
+        # Two levers shrink the program count when over budget, and the
+        # cheaper one (scheduled-pixel delta) is applied each round:
+        # * MERGE two partial groups at their elementwise-max join cell
+        #   (fewer groups, but small groups inherit a bigger shape);
+        # * DROP the smallest menu size (fewer sizes — remnants pad up to
+        #   the next size, a few fill slots, no shape inflation).
+        # Improvement merges (delta < 0, possible when quantum > 1 leaves
+        # fill) apply even within budget.
+        while True:
+            over = len(programs(groups)) > self.max_buckets
+            best = None  # (delta, kind, payload)
+            if len(groups) > 1:
+                for i in range(len(groups)):
+                    ki, ci, _ = groups[i]
+                    for j in range(i + 1, len(groups)):
+                        kj, cj, _ = groups[j]
+                        join = (max(ki[0], kj[0]), max(ki[1], kj[1]))
+                        delta = (cost(join, ci + cj)
+                                 - cost(ki, ci) - cost(kj, cj))
+                        if (delta < 0 or over) and (
+                                best is None or delta < best[0]):
+                            best = (delta, "merge", (i, j, join))
+            if over and len(menu) > 1:
+                shorter = menu[:-1]
+                delta = total_cost(groups, shorter) - total_cost(groups)
+                if best is None or delta < best[0]:
+                    best = (delta, "drop", shorter)
+            if best is None or (best[0] >= 0 and not over):
+                break
+            if best[1] == "drop":
+                menu = best[2]
+                continue
+            _, _, (i, j, join) = best
+            merged = (join, groups[i][1] + groups[j][1],
+                      tuple(sorted(set(groups[i][2] + groups[j][2]))))
+            groups = sorted([g for t, g in enumerate(groups)
+                             if t not in (i, j)] + [merged])
+
+        # Safety net: never schedule more pixels than the legacy path
+        # (improvement-only merging + pad-every-straggler-to-gbs) would.
+        # The greedy above can land worse when full-batch shapes alone
+        # saturate the budget and forced merges inflate small groups.
+        legacy = _merge_partial_groups(
+            [(k, [(k, True)] * c) for k, c, _ in
+             sorted((k, c % gbs, None) for k, c in counts.items() if c % gbs)],
+            gbs)
+        legacy_cost = sum(k[0] * k[1] * gbs * (-(-len(g) // gbs))
+                          for k, g in legacy)
+        if legacy and legacy_cost < total_cost(groups):
+            progs = set(full_programs) | {(k, gbs) for k, _ in legacy}
+            self._plan_cache = (None, progs)
+            return self._plan_cache
+        plan = [(k, srcs, self._decompose(c, menu)) for k, c, srcs in groups]
+        self._plan_cache = (plan, programs(groups))
+        return self._plan_cache
+
+    def program_count(self, epoch: int = 0) -> int:
+        """Distinct (bucket shape, batch size) pairs in this epoch's
+        schedule — the train step's true XLA compile count (with remnant
+        sub-batches, shapes alone undercount)."""
+        return len({(key, len(group))
+                    for key, group in self.global_schedule(epoch)})
+
     def global_schedule(self, epoch: int) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
         """Deterministic global batch plan: [(bucket_hw, [(idx, valid)] of
         length global_batch)] — identical on every host for a given
@@ -394,6 +564,27 @@ class ShardedBatcher:
             if len(group) == gbs:
                 schedule.append((key, group))
                 pending[key] = []
+        if self.bucket_ladder is not None and self.remnant_sizes:
+            # remnant sub-batches: emit each (merged) straggler group as a
+            # short menu of smaller static batches (near-zero fill) instead
+            # of one full-gbs batch that is mostly dead slots.  The plan —
+            # which cells merge where, and the part sizes — is a pure
+            # function of the shape histogram (_partial_plan), so it is
+            # identical on every host and in every epoch; the shuffle only
+            # decides which concrete items fill the slots.  plan=None means
+            # the planner proved the legacy path cheaper — fall through.
+            plan, _ = self._partial_plan()
+            if plan is not None:
+                for join_key, sources, parts in plan:
+                    items = [it for k in sources for it in pending.get(k, [])]
+                    pos = 0
+                    for size in parts:
+                        take = items[pos:pos + size]
+                        pos += size
+                        if len(take) < size:
+                            take = take + [(take[0][0], False)] * (size - len(take))
+                        schedule.append((join_key, take))
+                return schedule
         partials = sorted(((k, g) for k, g in pending.items() if g),
                           key=lambda kg: kg[0])
         if self.bucket_ladder is not None:
@@ -427,13 +618,18 @@ class ShardedBatcher:
         identical to the serial path: each item's RNG is keyed on
         (seed, epoch, idx), so determinism is independent of thread timing.
         """
-        lo = self.process_index * self.batch_size
-        hi = lo + self.batch_size
+        def host_slice(group):
+            # groups are gbs long, except remnant sub-batches (menu sizes,
+            # always a multiple of process_count by the quantum contract)
+            sub = len(group) // self.process_count
+            lo = self.process_index * sub
+            return group[lo:lo + sub]
+
         schedule = self.global_schedule(epoch)
         pool = self._ensure_pool()
         if pool is None:
             for key, group in schedule:
-                yield self._materialise(key, group[lo:hi], epoch)
+                yield self._materialise(key, host_slice(group), epoch)
             return
         # enough batches in flight to keep every worker busy even at
         # batch_size=1, but bounded so at most `window` decoded batches
@@ -450,7 +646,7 @@ class ShardedBatcher:
         while i < len(schedule) or inflight:
             while i < len(schedule) and len(inflight) < window:
                 key, group = schedule[i]
-                inflight.append(submit(key, group[lo:hi]))
+                inflight.append(submit(key, host_slice(group)))
                 i += 1
             key, group, futs = inflight.popleft()
             items = [f.result() for f in futs]

@@ -506,3 +506,156 @@ class TestScheduleOverhead:
         merged = ShardedBatcher(self._ds(sizes), 4, shuffle=False,
                                 pad_multiple="auto", max_buckets=6)
         assert merged.schedule_overhead(0) < unmerged.schedule_overhead(0)
+
+
+def _bench_like_shapes(n=64, seed=0):
+    """The bench_suite distribution: 40% at a dominant resolution, the rest
+    uniformly wild — the histogram real crowd datasets have."""
+    rng = np.random.default_rng(seed)
+    shapes = []
+    for _ in range(n):
+        if rng.uniform() < 0.4:
+            shapes.append((768, 1024))
+        else:
+            shapes.append(((int(rng.integers(384, 1025)) // 8) * 8,
+                           (int(rng.integers(384, 1025)) // 8) * 8))
+    return shapes
+
+
+class TestRemnantSubBatches:
+    """VERDICT r3 item 1: partial ladder groups used to pad to the full
+    global batch — ~11% of step compute was dead fill slots on the bench
+    distribution.  Remnant sub-batches emit stragglers at a power-of-two
+    menu of smaller static batch sizes instead."""
+
+    @staticmethod
+    def _ds(sizes):
+        ds = _ShapeOnlyDataset(0)
+        ds.shapes = list(sizes)
+        return ds
+
+    def _mk(self, sizes, bs=8, **kw):
+        kw.setdefault("max_buckets", 24)
+        kw.setdefault("batch_quantum", 1)
+        return ShardedBatcher(self._ds(sizes), bs, shuffle=True, seed=0,
+                              pad_multiple="auto", remnant_sizes=True, **kw)
+
+    def test_kills_dead_slot_overhead(self):
+        sizes = _bench_like_shapes()
+        plain = ShardedBatcher(self._ds(sizes), 8, shuffle=True, seed=0,
+                               pad_multiple="auto", max_buckets=24)
+        remnant = self._mk(sizes)
+        assert remnant.padding_overhead() == plain.padding_overhead()
+        # the done-criterion: schedule overhead within ~2 points of the
+        # irreducible padding overhead (was ~22 points over, r3 telemetry)
+        assert (remnant.schedule_overhead(0)
+                <= remnant.padding_overhead() + 0.02)
+        assert remnant.schedule_overhead(0) < plain.schedule_overhead(0)
+
+    def test_program_budget_holds(self):
+        b = self._mk(_bench_like_shapes())
+        assert b.program_count(0) <= 24
+        # shapes stay within the ladder grid (joins are grid cells)
+        assert b.distinct_shapes(0) <= 24
+
+    def test_schedule_is_epoch_invariant_in_length_and_shapes(self):
+        # cell membership is shape-determined, so per-cell counts — hence
+        # the whole (shape, size) schedule skeleton — cannot vary with the
+        # shuffle.  This is what lets cli/train.py size the LR schedule
+        # from epoch 0 (VERDICT r3 item 8).
+        b = self._mk(_bench_like_shapes())
+        skel0 = [(k, len(g)) for k, g in b.global_schedule(0)]
+        for e in (1, 5, 9):
+            assert [(k, len(g)) for k, g in b.global_schedule(e)] == skel0
+
+    def test_item_coverage_and_fill_only_in_cover_part(self):
+        b = self._mk(_bench_like_shapes())
+        seen = []
+        for key, group in b.global_schedule(3):
+            valid = [i for i, v in group if v]
+            seen += valid
+            # fill slots, if any, are a contiguous tail
+            flags = [v for _, v in group]
+            assert flags == sorted(flags, reverse=True)
+        assert sorted(seen) == list(range(64))
+
+    def test_lockstep_across_hosts_with_quantum(self):
+        sizes = _bench_like_shapes()
+        skels, totals = [], []
+        for r in range(2):
+            b = ShardedBatcher(self._ds(sizes), 4, shuffle=True, seed=0,
+                               process_index=r, process_count=2,
+                               pad_multiple="auto", max_buckets=24,
+                               remnant_sizes=True, batch_quantum=2)
+            sch = b.global_schedule(2)
+            skels.append([(k, len(g)) for k, g in sch])
+            # every part splits evenly across the 2 hosts
+            assert all(len(g) % 2 == 0 for _, g in sch)
+            totals.append(sum(1 for _, g in sch for _, v in g if v))
+        assert skels[0] == skels[1]
+        assert totals[0] == 64
+
+    def test_parts_are_menu_sizes_and_quantum_multiples(self):
+        b = self._mk(_bench_like_shapes(), bs=8, batch_quantum=2)
+        menu = set(b._remnant_menu())
+        assert menu == {8, 2, 4}
+        for _, group in b.global_schedule(0):
+            assert len(group) in menu
+            assert len(group) % 2 == 0
+
+    def test_quantum_validation(self):
+        with pytest.raises(ValueError, match="process_count"):
+            ShardedBatcher(self._ds([(64, 64)]), 4, process_count=3,
+                           remnant_sizes=True, batch_quantum=4)
+        with pytest.raises(ValueError, match="batch_quantum"):
+            ShardedBatcher(self._ds([(64, 64)]), 6, remnant_sizes=True,
+                           batch_quantum=4)
+
+    def test_decompose(self):
+        d = ShardedBatcher._decompose
+        assert d(13, (16, 8, 4, 2, 1)) == (8, 4, 1)
+        assert d(16, (16, 8, 4, 2, 1)) == (16,)
+        assert d(3, (16, 8, 4)) == (4,)          # cover part carries fill
+        assert d(21, (16, 8, 4)) == (16, 8)      # peel then cover
+        assert d(5, (8, 4, 2)) == (4, 2)
+
+    def test_never_worse_than_legacy_padding(self):
+        # when full-batch shapes saturate max_buckets (large datasets), the
+        # planner must fall back to the legacy merge+pad path rather than
+        # force-merge remnants into huge join cells (code-review r4 finding)
+        for n, seed, mb in [(64, 0, 24), (500, 2, 24), (500, 1, 16),
+                            (2000, 0, 16), (2000, 1, 24)]:
+            sizes = _bench_like_shapes(n=n, seed=seed)
+            legacy = ShardedBatcher(self._ds(sizes), 8, shuffle=True, seed=0,
+                                    pad_multiple="auto", max_buckets=mb)
+            remnant = self._mk(sizes, max_buckets=mb)
+            assert (remnant.schedule_overhead(1)
+                    <= legacy.schedule_overhead(1) + 1e-9), (n, seed, mb)
+
+    def test_lr_schedule_covers_actual_steps(self):
+        # VERDICT r3 item 8: cli/train.py sizes the LR schedule from
+        # batches_per_epoch(0).  That is exact in every bucketing mode —
+        # per-cell item counts are shape-determined, so the batch count
+        # cannot drift with the shuffle — for merged ladders, remnant
+        # plans, exact shapes, and fixed multiples alike.
+        sizes = _bench_like_shapes(n=37, seed=3)
+        for kw in (dict(pad_multiple="auto", max_buckets=24),
+                   dict(pad_multiple="auto", max_buckets=24,
+                        remnant_sizes=True, batch_quantum=1),
+                   dict(pad_multiple=None),
+                   dict(pad_multiple=64)):
+            b = ShardedBatcher(self._ds(sizes), 8, shuffle=True, seed=0, **kw)
+            n0 = b.batches_per_epoch(0)
+            assert all(b.batches_per_epoch(e) == n0 for e in (1, 4, 11))
+
+    def test_off_by_default_and_outside_ladder_mode(self):
+        sizes = _bench_like_shapes()
+        b = ShardedBatcher(self._ds(sizes), 8, shuffle=True, seed=0,
+                           pad_multiple="auto", max_buckets=24)
+        assert not b.remnant_sizes
+        gbs = 8
+        assert all(len(g) == gbs for _, g in b.global_schedule(0))
+        # exact mode ignores the flag (zero-padding promise)
+        ex = ShardedBatcher(self._ds(sizes[:4]), 8, shuffle=False,
+                            pad_multiple=None, remnant_sizes=True)
+        assert all(len(g) == gbs for _, g in ex.global_schedule(0))
