@@ -37,7 +37,23 @@ def measure(ndev_use: int, *, b: int, h: int, w: int, steps: int,
     from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
     from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
 
-    devices = jax.devices()[:ndev_use]
+    if ndev_use == jax.device_count():
+        devices = jax.devices()  # full mesh: valid on pods too
+    else:
+        # sub-full sweep points: jax.devices() on a multi-host pod includes
+        # non-addressable devices, and a mesh that drops some hosts'
+        # devices can't be fed by those hosts — so sub-full counts are
+        # single-host only, built from local (addressable) devices
+        local = jax.local_devices()
+        if jax.process_count() > 1:
+            raise SystemExit(
+                f"ndev={ndev_use}: sub-full sweep points require a "
+                f"single-host run (multi-host meshes must include every "
+                f"process's devices); run the sweep per host or at the "
+                f"full device count")
+        if ndev_use > len(local):
+            raise SystemExit(f"ndev={ndev_use} > {len(local)} local devices")
+        devices = local[:ndev_use]
     mesh = make_mesh(devices)
     rng = np.random.default_rng(0)
     local_b = b * ndev_use

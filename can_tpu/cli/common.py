@@ -114,6 +114,127 @@ def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:
     return mesh, global_batch // nproc, dp
 
 
+def activation_bytes(batch: int, h: int, w: int, *,
+                     bf16: bool = False) -> int:
+    """Peak train-step HBM footprint estimate for one CANNet launch.
+
+    Linear in pixels; the constant is MEASURED, not analytic: the r4 OOM
+    dump for b16 x 1016x1024 bf16 (16.65 Mpx) showed a 16.97 GiB program —
+    ~1030 B/px — dominated by the full-res backward temporaries
+    (bf16[B,H,W,64] conv-transpose + select_and_scatter buffers, each with
+    2x lane-padding on the 64-channel dim).  jax.checkpoint barely moves
+    this peak (the temporaries live INSIDE the rematerialised backward
+    segment), which is why the planner's per-launch pixel cap
+    (max_launch_pixels), not remat, is the primary fits-in-HBM mechanism.
+    Consistent with every observed fit: b16 576x768 (7.5 GiB est) and
+    b8 1016x1024 (8.8 GiB est) train fine; b16 1016x1024 (17.6 GiB est)
+    OOMs with or without remat.  f32 doubles the bf16 footprint.
+    """
+    per_px = 1030.0 if bf16 else 2060.0
+    return int(batch * h * w * per_px)
+
+
+def device_memory_bytes() -> Optional[int]:
+    """Per-device HBM (bytes_limit), or None when the backend doesn't
+    report one (CPU).  Callers must treat None as 'no device memory
+    ceiling' — inventing a number here would let a fictitious 16 GiB
+    drive real scheduling (launch caps, remat, LR-schedule step counts)
+    on backends whose only limit is host RAM."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return None
+
+
+def max_launch_pixels(*, bf16: bool, ceiling_frac: float = 0.92,
+                      hbm_bytes: Optional[int] = None) -> Optional[float]:
+    """Per-launch pixel budget (batch * H * W) for the remnant planner's
+    HBM cap (ShardedBatcher max_launch_px), or None on backends with no
+    device-memory ceiling (CPU) — there the cap would be fiction and
+    would shift batch counts (hence LR schedules) vs the TPU run.
+
+    Calibrated to the measured worst case, not the analytic optimum: even
+    WITH remat, the b16 x 1016x1024 backward peaked at ~17.2 GiB for
+    16.65 Mpx (~1030 B/px: the full-res conv-transpose temporaries plus
+    XLA's 2x lane-padding on the 64-channel stem dominate, r4 OOM dump).
+    ~1100 B/px (bf16; f32 doubles it) against ``ceiling_frac`` of HBM
+    admits every configuration that has been seen to fit (b16 768x1024,
+    b8 1016x1024) and rejects the one that OOM'd.  ``hbm_bytes``
+    overrides autodetection (tests pin it).
+    """
+    mem = hbm_bytes if hbm_bytes is not None else device_memory_bytes()
+    if mem is None:
+        return None
+    per_px = 1100.0 if bf16 else 2200.0
+    return ceiling_frac * mem / per_px
+
+
+def make_remat_policy(remat_flag: str, *, global_batch: int,
+                      bf16: bool, budget_frac: float = 0.80,
+                      announce: bool = False,
+                      hbm_bytes: Optional[int] = None):
+    """Per-bucket rematerialisation decision (VERDICT r3 item 3).
+
+    ``--remat on`` / ``off`` force the choice globally; ``auto`` (default)
+    enables jax.checkpoint only for bucket shapes whose estimated peak
+    footprint exceeds ``budget_frac`` of device HBM — the narrow band
+    just under the per-launch pixel cap, where shaving the cross-segment
+    activations buys headroom.  Small buckets keep the full-speed
+    backward; shapes beyond the cap never launch at that batch at all
+    (the planner's max_launch_px runs them at a smaller menu size — the
+    reference's only fits-anything answer was batch-1, train.py:177).
+
+    Returns ``policy(image_hw, batch=None) -> bool`` (batch defaults to the
+    full global batch; remnant sub-batches pass their smaller actual size,
+    so a big-shape straggler can still skip remat).
+    """
+    if remat_flag in ("on", "off"):
+        return lambda hw, batch=None: remat_flag == "on"
+    mem = hbm_bytes if hbm_bytes is not None else device_memory_bytes()
+    if mem is None:
+        # no device-memory ceiling reported (CPU backend): auto-remat
+        # would be keyed to a made-up number — keep the fast backward
+        return lambda hw, batch=None: False
+    budget = int(mem * budget_frac)
+
+    def policy(hw, batch=None):
+        b = batch or global_batch
+        need = activation_bytes(b, hw[0], hw[1], bf16=bf16) > budget
+        if need and announce and (b, hw) not in policy._said:
+            policy._said.add((b, hw))
+            print(f"[remat] bucket {hw[0]}x{hw[1]} (batch {b}): activation "
+                  f"estimate exceeds {budget_frac:.0%} of HBM -> "
+                  f"rematerialising backward for this bucket")
+        return need
+
+    policy._said = set()
+    return policy
+
+
+def make_bucketed_train_step(apply_fn, optimizer, mesh, *, compute_dtype,
+                             policy):
+    """Data-parallel train step with per-bucket remat dispatch: two jitted
+    step objects (remat on/off); jit caches per batch shape under each, so
+    every bucket runs the cheapest variant the ``policy`` (make_remat_policy)
+    allows.  Shared by the train CLI and bench_suite so the bench measures
+    exactly the CLI's dispatch."""
+    from can_tpu.parallel import make_dp_train_step
+
+    steps = {flag: make_dp_train_step(apply_fn, optimizer, mesh,
+                                      compute_dtype=compute_dtype,
+                                      remat=flag)
+             for flag in (False, True)}
+
+    def train_step(state, batch):
+        shape = batch["image"].shape
+        return steps[policy(tuple(shape[1:3]), batch=shape[0])](state, batch)
+
+    return train_step
+
+
 def make_inference_forward():
     """Jitted single-image forward that handles both model variants:
     ``fwd(params, image, batch_stats_or_None)`` (shared by the train CLI's

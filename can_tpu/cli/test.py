@@ -87,6 +87,9 @@ def parse_args(argv=None):
                    help="with --pad-multiple auto, pad straggler groups to "
                         "the full batch instead of emitting smaller "
                         "sub-batches (see train CLI)")
+    p.add_argument("--launch-cost-mpx", type=float, default=2.0,
+                   help="per-launch cost for the remnant planner, in "
+                        "megapixel-equivalents (see train CLI)")
     return p.parse_args(argv)
 
 
@@ -150,7 +153,8 @@ def main(argv=None) -> int:
                                  num_workers=resolve_num_workers(args),
                                  max_buckets=args.max_buckets,
                                  remnant_sizes=not args.no_remnant_batches,
-                                 batch_quantum=_math.lcm(dp, process_count()))
+                                 batch_quantum=_math.lcm(dp, process_count()),
+                                 launch_cost_px=args.launch_cost_mpx * 1e6)
         if process_index() == 0:
             # main-process-only: the telemetry re-scans every image header,
             # and a pod would otherwise emit one duplicate line per process
@@ -174,11 +178,14 @@ def main(argv=None) -> int:
         else:
             eval_step = make_dp_eval_step(cannet_apply, mesh,
                                           compute_dtype=compute_dtype)
-        metrics = evaluate(eval_step, params, batcher.epoch(0),
-                           put_fn=lambda b: make_global_batch(
-                               b, mesh, spatial=args.sp > 1),
-                           dataset_size=batcher.dataset_size,
-                           show_progress=True, batch_stats=batch_stats)
+        try:
+            metrics = evaluate(eval_step, params, batcher.epoch(0),
+                               put_fn=lambda b: make_global_batch(
+                                   b, mesh, spatial=args.sp > 1),
+                               dataset_size=batcher.dataset_size,
+                               show_progress=True, batch_stats=batch_stats)
+        finally:
+            batcher.close()
         print(f"[result] images={metrics['num_images']} "
               f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 

@@ -35,6 +35,7 @@ from can_tpu.cli.common import (
     SpatialStepCache,
     build_mesh_and_batch,
     make_cached_sp_eval_step,
+    make_remat_policy,
     parse_pad_multiple,
     resolve_split_roots,
     resolve_sp_padding,
@@ -50,7 +51,6 @@ from can_tpu.parallel import (
     init_runtime,
     is_main_process,
     make_dp_eval_step,
-    make_dp_train_step,
     make_global_batch,
     process_count,
     process_index,
@@ -117,10 +117,15 @@ def parse_args(argv=None):
                         "traffic, XLA fuses the normalise into the first "
                         "conv (pixels differ from the f32 path only by u8 "
                         "rounding in the resize)")
-    p.add_argument("--remat", action="store_true",
+    p.add_argument("--remat", nargs="?", const="on", default="auto",
+                   choices=["auto", "on", "off"],
                    help="rematerialise the forward in backward "
                         "(jax.checkpoint): ~1/3 more FLOPs for far less "
-                        "activation HBM — for very large batches/resolutions")
+                        "activation HBM. 'auto' (default) enables it per "
+                        "bucket shape, only where the activation estimate "
+                        "would overflow HBM (cli/common.py "
+                        "make_remat_policy); bare --remat forces it on, "
+                        "'off' disables")
     p.add_argument("--vgg16-npz", type=str, default="",
                    help="pretrained VGG-16 frontend .npz (tools/convert_vgg16.py)")
     p.add_argument("--eval-interval", type=int, default=1)
@@ -146,6 +151,12 @@ def parse_args(argv=None):
                         "makes the one-time bill cheap. Measured on the "
                         "bench distribution: 8 -> 41.5, 16 -> 50.4, "
                         "24 -> 56.3 img/s")
+    p.add_argument("--s2d-stem", action="store_true",
+                   help="space-to-depth the VGG stem: fold the 3-channel "
+                        "first conv into (H/2, W/2, 12) packed space so its "
+                        "contraction uses 108 of the MXU's 128 K-lanes "
+                        "instead of 27 — numerically identical "
+                        "(ops/conv.py fold_stem_kernel); dp path only")
     p.add_argument("--no-remnant-batches", action="store_true",
                    help="disable remnant sub-batches: with --pad-multiple "
                         "auto, straggler groups normally run at a small "
@@ -153,6 +164,14 @@ def parse_args(argv=None):
                         "slots; each (shape x size) program counts against "
                         "--max-buckets) instead of padding to the full "
                         "global batch")
+    p.add_argument("--launch-cost-mpx", type=float, default=2.0,
+                   help="fixed cost of one extra step launch, in "
+                        "megapixel-equivalents, for the remnant planner's "
+                        "pixels-vs-launches trade. The conservative "
+                        "default (~50 ms at the chip's measured rate) "
+                        "suits high-dispatch-latency links; hosts with "
+                        "sub-ms dispatch should pass ~0.05 to unlock "
+                        "exact straggler splits")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -221,8 +240,20 @@ def main(argv=None) -> int:
                   min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
                   num_workers=num_workers, max_buckets=args.max_buckets,
                   remnant_sizes=not args.no_remnant_batches,
-                  batch_quantum=quantum)
-    train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True, **common)
+                  batch_quantum=quantum,
+                  launch_cost_px=args.launch_cost_mpx * 1e6)
+    if not args.no_remnant_batches:
+        # HBM cap per launch: bucket cells too big for the full global
+        # batch run at a smaller menu size instead of OOMing (train only —
+        # eval has no backward, so the test batcher stays uncapped)
+        from can_tpu.cli.common import max_launch_pixels
+
+        train_common = dict(common,
+                            max_launch_px=max_launch_pixels(bf16=args.bf16))
+    else:
+        train_common = common
+    train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True,
+                                   **train_common)
     test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
     if main_proc:
         print(f"[data] train={len(train_ds)} test={len(test_ds)} "
@@ -276,20 +307,32 @@ def main(argv=None) -> int:
             print(f"[resume] no checkpoint in {args.init_checkpoint}; cold start")
 
     apply_fn = cannet_apply
+    if args.s2d_stem:
+        if args.sp > 1:
+            raise SystemExit("--s2d-stem is dp-path only (the sp step "
+                             "builds its own sharded apply)")
+        import functools
+
+        apply_fn = functools.partial(cannet_apply, s2d_stem=True)
+    remat_policy = make_remat_policy(args.remat,
+                                     global_batch=args.batch_size * dp,
+                                     bf16=args.bf16, announce=main_proc)
     if args.sp > 1:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
                                           compute_dtype=compute_dtype,
-                                          remat=args.remat))
+                                          remat=remat_policy(hw)))
 
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)
 
         eval_step = make_cached_sp_eval_step(mesh, compute_dtype=compute_dtype)
     else:
-        train_step = make_dp_train_step(apply_fn, optimizer, mesh,
-                                        compute_dtype=compute_dtype,
-                                        remat=args.remat)
+        from can_tpu.cli.common import make_bucketed_train_step
+
+        train_step = make_bucketed_train_step(apply_fn, optimizer, mesh,
+                                              compute_dtype=compute_dtype,
+                                              policy=remat_policy)
         eval_step = make_dp_eval_step(apply_fn, mesh,
                                       compute_dtype=compute_dtype)
     # batches are H-sharded when sp > 1 (train and eval both)
@@ -346,6 +389,8 @@ def main(argv=None) -> int:
         print(f"[abort] {e}", file=sys.stderr)
         return 1
     finally:
+        train_batcher.close()
+        test_batcher.close()
         ckpt.wait()
         ckpt.close()
         logger.finish()

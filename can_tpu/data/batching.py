@@ -158,7 +158,9 @@ class ShardedBatcher:
                  min_bucket_h: Optional[int] = None,
                  num_workers: int = 0,
                  remnant_sizes: bool = False,
-                 batch_quantum: Optional[int] = None):
+                 batch_quantum: Optional[int] = None,
+                 launch_cost_px: float = 2e6,
+                 max_launch_px: Optional[float] = None):
         self.dataset = dataset
         self.batch_size = int(batch_size)
         # remnant sub-batches (ladder mode only): emit partial groups at a
@@ -171,6 +173,25 @@ class ShardedBatcher:
         # promises.  The CLIs/bench enable it with the right quantum.
         self.remnant_sizes = bool(remnant_sizes)
         self.batch_quantum = int(batch_quantum or process_count or 1)
+        # fixed cost of one extra step launch, in pixel-equivalents, for
+        # the remnant planner's pixels-vs-launches trade (see _decompose).
+        # The default is deliberately conservative (~a 1-2 Mpx image's
+        # compute): hosts with sub-ms dispatch can pass ~5e4 to unlock
+        # exact splits; the dev tunnel measured ~50 ms/launch (~2 Mpx at
+        # the chip's ~42 Mpx/s), where splitting is a net loss
+        self.launch_cost_px = float(launch_cost_px)
+        # HBM ceiling per launch, in pixels (batch * H * W): bucket cells
+        # whose full-batch launch would overflow device memory run at the
+        # largest menu size that fits instead (the train step's activation
+        # footprint is linear in pixels — cli/common.py max_launch_pixels
+        # derives the value from HBM).  Ladder+remnant mode only; None =
+        # uncapped.  This is what makes big-batch training runnable on
+        # wild datasets whose largest shapes don't fit at the global batch
+        # (the reference's only fits-anything answer was batch-1,
+        # reference train.py:177).
+        self.max_launch_px = (None if max_launch_px is None
+                              else float(max_launch_px))
+        self._cap_warned: set = set()
         self._plan_cache = None
         # host loader threads (the reference's DataLoader num_workers,
         # train.py:90, done with threads: PIL decode / cv2 resize release
@@ -411,21 +432,68 @@ class ShardedBatcher:
             s *= 2
         return tuple(sorted(menu, reverse=True))
 
+    def _menu_for(self, key: Tuple[int, int],
+                  menu: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Menu filtered by the per-launch pixel cap for this cell; the
+        smallest size always survives (the floor below which the batcher
+        cannot subdivide — the quantum).  When even the quantum exceeds
+        the cap, the cell launches anyway at the floor size — warned
+        loudly ONCE, because the cap's no-OOM promise no longer holds for
+        that cell (the alternative, refusing the item, would silently
+        drop data)."""
+        if self.max_launch_px is None:
+            return menu
+        area = key[0] * key[1]
+        kept = tuple(s for s in menu if s * area <= self.max_launch_px)
+        if not kept:
+            floor = min(menu)
+            if key not in self._cap_warned:
+                self._cap_warned.add(key)
+                print(f"[batching] WARNING: bucket {key[0]}x{key[1]} exceeds "
+                      f"the per-launch pixel cap even at the minimum batch "
+                      f"{floor} ({floor * area / 1e6:.1f} Mpx > "
+                      f"{self.max_launch_px / 1e6:.1f} Mpx) — launching "
+                      f"anyway; expect HBM pressure (shrink batch_quantum "
+                      f"or image sizes)")
+            return (floor,)
+        return kept
+
+    def _cell_gbs(self, key: Tuple[int, int], menu: Tuple[int, ...]) -> int:
+        """Full-batch size for this cell: the global batch, unless the
+        pixel cap forces a smaller launch."""
+        return max(self._menu_for(key, menu))
+
     @staticmethod
-    def _decompose(n: int, menu: Tuple[int, ...]) -> Tuple[int, ...]:
-        """Cover ``n`` items with menu-size parts minimising (total slots,
-        launch count) — exact tiny DP (n is at most a few global batches).
-        Deterministic; parts returned descending, so any fill slots land in
-        the final (smallest) part."""
+    def _decompose(n: int, menu: Tuple[int, ...], area: float = 1.0,
+                   launch_cost: float = 0.0) -> Tuple[int, ...]:
+        """Cover ``n`` items with menu-size parts minimising
+        ``area * total_slots + launch_cost * n_parts`` — exact tiny DP
+        (n is at most a few global batches).
+
+        ``launch_cost`` (pixel-equivalents per step launch) is what makes
+        the plan hardware-honest: with free launches the optimum is an
+        exact split (8+4+1 for 13), but a TPU step has a fixed dispatch/
+        overhead cost, so splitting a straggler group into several small
+        batches can cost more than the dead slots it saves (measured on
+        the dev tunnel: ~50 ms/launch, tools/diag_remnant.py r4).  A large
+        launch_cost collapses the decomposition to a single cover part —
+        the smallest menu size >= n — which never launches more often OR
+        schedules more pixels than padding to the full global batch.
+
+        Deterministic; parts returned descending, so any fill slots land
+        in the final (smallest) part."""
         memo = {}
 
         def f(r):
             if r <= 0:
-                return (0, 0, ())
+                return (0.0, 0, ())
             got = memo.get(r)
             if got is None:
+                # ties on cost prefer fewer launches, then the
+                # lexicographically smallest part tuple (determinism)
                 got = memo[r] = min(
-                    (s + sub[0], 1 + sub[1], (s,) + sub[2])
+                    (area * s + launch_cost + sub[0], 1 + sub[1],
+                     (s,) + sub[2])
                     for s in menu
                     for sub in (f(r - s),))
             return got
@@ -463,23 +531,37 @@ class ShardedBatcher:
             return self._plan_cache
         gbs = self.batch_size * self.process_count
         menu = self._remnant_menu()
+        lc = float(self.launch_cost_px)
         counts = collections.Counter(
             self._bucket_key(self._item_shape(i))
             for i in range(len(self.dataset)))
-        full_programs = {(k, gbs) for k, c in counts.items() if c >= gbs}
-        groups = sorted((k, c % gbs, (k,))
-                        for k, c in counts.items() if c % gbs)
+        full_programs = set()
+        groups = []
+        for k, c in sorted(counts.items()):
+            cg = self._cell_gbs(k, menu)  # pixel cap may shrink this cell's
+            if c >= cg:                   # full-batch size below gbs
+                full_programs.add((k, cg))
+            if c % cg:
+                groups.append((k, c % cg, (k,)))
+        groups.sort()
 
         def cost(key, count, m=None):
-            return key[0] * key[1] * sum(self._decompose(count, m or menu))
+            area = key[0] * key[1]
+            parts = self._decompose(count, self._menu_for(key, m or menu),
+                                    area, lc)
+            return area * sum(parts) + lc * len(parts)
 
         def total_cost(gs, m=None):
             return sum(cost(k, c, m) for k, c, _ in gs)
 
+        def parts_of(k, c, m=None):
+            return self._decompose(c, self._menu_for(k, m or menu),
+                                   k[0] * k[1], lc)
+
         def programs(gs, m=None):
             ps = set(full_programs)
             for k, c, _ in gs:
-                ps.update((k, s) for s in self._decompose(c, m or menu))
+                ps.update((k, s) for s in parts_of(k, c, m))
             return ps
 
         # Two levers shrink the program count when over budget, and the
@@ -504,11 +586,19 @@ class ShardedBatcher:
                         if (delta < 0 or over) and (
                                 best is None or delta < best[0]):
                             best = (delta, "merge", (i, j, join))
+            # menu-drop lever: under a pixel cap, dropping the smallest
+            # size is only legal if every cell (full-batch AND partial)
+            # still has a fitting launch size afterwards
             if over and len(menu) > 1:
                 shorter = menu[:-1]
-                delta = total_cost(groups, shorter) - total_cost(groups)
-                if best is None or delta < best[0]:
-                    best = (delta, "drop", shorter)
+                cap = self.max_launch_px
+                safe = cap is None or all(
+                    any(s * k[0] * k[1] <= cap for s in shorter)
+                    for k in counts)
+                if safe:
+                    delta = total_cost(groups, shorter) - total_cost(groups)
+                    if best is None or delta < best[0]:
+                        best = (delta, "drop", shorter)
             if best is None or (best[0] >= 0 and not over):
                 break
             if best[1] == "drop":
@@ -524,17 +614,22 @@ class ShardedBatcher:
         # (improvement-only merging + pad-every-straggler-to-gbs) would.
         # The greedy above can land worse when full-batch shapes alone
         # saturate the budget and forced merges inflate small groups.
-        legacy = _merge_partial_groups(
-            [(k, [(k, True)] * c) for k, c, _ in
-             sorted((k, c % gbs, None) for k, c in counts.items() if c % gbs)],
-            gbs)
-        legacy_cost = sum(k[0] * k[1] * gbs * (-(-len(g) // gbs))
-                          for k, g in legacy)
-        if legacy and legacy_cost < total_cost(groups):
-            progs = set(full_programs) | {(k, gbs) for k, _ in legacy}
-            self._plan_cache = (None, progs)
-            return self._plan_cache
-        plan = [(k, srcs, self._decompose(c, menu)) for k, c, srcs in groups]
+        # Skipped under a pixel cap: legacy pads every straggler to the
+        # FULL global batch, which is exactly what a capped cell must not
+        # launch.
+        if self.max_launch_px is None:
+            legacy = _merge_partial_groups(
+                [(k, [(k, True)] * c) for k, c, _ in
+                 sorted((k, c % gbs, None)
+                        for k, c in counts.items() if c % gbs)],
+                gbs)
+            legacy_cost = sum((k[0] * k[1] * gbs + lc) * (-(-len(g) // gbs))
+                              for k, g in legacy)
+            if legacy and legacy_cost < total_cost(groups):
+                progs = set(full_programs) | {(k, gbs) for k, _ in legacy}
+                self._plan_cache = (None, progs)
+                return self._plan_cache
+        plan = [(k, srcs, parts_of(k, c)) for k, c, srcs in groups]
         self._plan_cache = (plan, programs(groups))
         return self._plan_cache
 
@@ -555,13 +650,24 @@ class ShardedBatcher:
         else:
             order = np.arange(n)
         gbs = self.batch_size * self.process_count
+        remnant_mode = self.bucket_ladder is not None and self.remnant_sizes
+        menu = self._remnant_menu() if remnant_mode else None
+        full_size = {}  # per-cell full-batch size (pixel cap may shrink it)
+
+        def cell_full(key):
+            s = full_size.get(key)
+            if s is None:
+                s = full_size[key] = (self._cell_gbs(key, menu)
+                                      if remnant_mode else gbs)
+            return s
+
         pending: Dict[Tuple[int, int], List[Tuple[int, bool]]] = {}
         schedule = []
         for idx in order.tolist():
             key = self._bucket_key(self._item_shape(idx))
             group = pending.setdefault(key, [])
             group.append((idx, True))
-            if len(group) == gbs:
+            if len(group) == cell_full(key):
                 schedule.append((key, group))
                 pending[key] = []
         if self.bucket_ladder is not None and self.remnant_sizes:
@@ -643,15 +749,42 @@ class ShardedBatcher:
             return key, group, futs
 
         i = 0
-        while i < len(schedule) or inflight:
-            while i < len(schedule) and len(inflight) < window:
-                key, group = schedule[i]
-                inflight.append(submit(key, host_slice(group)))
-                i += 1
-            key, group, futs = inflight.popleft()
-            items = [f.result() for f in futs]
-            yield pad_batch(items, key, len(group),
-                            [v for _, v in group], self.ds)
+        try:
+            while i < len(schedule) or inflight:
+                while i < len(schedule) and len(inflight) < window:
+                    key, group = schedule[i]
+                    inflight.append(submit(key, host_slice(group)))
+                    i += 1
+                key, group, futs = inflight.popleft()
+                items = [f.result() for f in futs]
+                yield pad_batch(items, key, len(group),
+                                [v for _, v in group], self.ds)
+        finally:
+            # an abandoned generator (break mid-epoch, error downstream)
+            # must not leave up to window*batch_size decode tasks running
+            for _, _, futs in inflight:
+                for f in futs:
+                    f.cancel()
+
+    def close(self) -> None:
+        """Shut down the loader thread pool (idempotent).  The batcher
+        stays usable — the pool is re-created on the next epoch() — so
+        this is a resource release, not a terminal state."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self) -> "ShardedBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _ensure_pool(self) -> Optional[ThreadPoolExecutor]:
         if self.num_workers > 0 and self._pool is None:

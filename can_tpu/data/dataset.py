@@ -67,7 +67,10 @@ def _read_image_u8(path: str) -> np.ndarray:
         # so the dtype's max lands on 255 — signed types have one fewer
         # value bit, so the shift comes from log2(max+1), not itemsize
         shift = max(0, int(np.iinfo(arr.dtype).max + 1).bit_length() - 1 - 8)
-        return (arr >> shift).astype(np.uint8)
+        # clip negatives BEFORE the u8 cast: a signed source (e.g. int32 -1)
+        # would otherwise wrap to a bright value, unlike the f32 path whose
+        # /max keeps the sign (advisor r3)
+        return np.clip(arr >> shift, 0, 255).astype(np.uint8)
     return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
 
 

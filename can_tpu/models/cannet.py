@@ -151,6 +151,7 @@ def cannet_apply(
     batch_stats: Any = None,
     train: bool = False,
     bn_momentum: float = 0.1,
+    s2d_stem: bool = False,
 ):
     """Forward pass: NHWC image batch -> (N, H/8, W/8, 1) density map.
 
@@ -174,8 +175,27 @@ def cannet_apply(
 
     def conv_block(x, group, i, dilation):
         p = params[group][i]
-        y = ops.conv2d(x, p["w"].astype(x.dtype), p["b"].astype(x.dtype),
-                       dilation=dilation, precision=precision)
+        if s2d_stem and group == "frontend" and i == 0:
+            # space-to-depth stem (VERDICT r3 item 2): the 3-channel first
+            # conv contracts only K=27 of the MXU's 128 K-lanes; fold it
+            # into packed space (K=108, 1/4 the positions) — numerically
+            # identical (ops/conv.py fold_stem_kernel; pinned by
+            # tests/test_ops.py::TestSpaceToDepthStem).  The fold is linear
+            # in w, so gradients train the ORIGINAL stem weights.
+            from can_tpu.ops.conv import (
+                depth_to_space,
+                fold_stem_kernel,
+                space_to_depth,
+            )
+
+            wp, bp = fold_stem_kernel(p["w"].astype(x.dtype),
+                                      p["b"].astype(x.dtype))
+            y = ops.conv2d(space_to_depth(x), wp, bp, dilation=dilation,
+                           precision=precision)
+            y = depth_to_space(y)
+        else:
+            y = ops.conv2d(x, p["w"].astype(x.dtype), p["b"].astype(x.dtype),
+                           dilation=dilation, precision=precision)
         if bn:
             stats = None if batch_stats is None else batch_stats[group][i]
             y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum,

@@ -1,0 +1,145 @@
+"""Quantify bucketed-eval error vs exact shapes (VERDICT r3 item 6).
+
+The eval CLI defaults to ``--pad-multiple exact`` — one XLA program per
+distinct resolution (~182 programs on ShanghaiTech-A's test split) — on the
+theory that padding perturbs the boundary math.  This measures that
+perturbation instead of assuming it.
+
+Mechanics, established by the probes below:
+
+* conv / maxpool layers are EXACTLY invariant to shape-bucket padding
+  while biases are zero: the padded canvas's zeros land where SAME
+  padding's zeros would, so zero stays zero through the whole frontend;
+* any nonzero bias lights the padded region up, and the context block's
+  adaptive average pooling spans the whole padded canvas (reference
+  model/CANNet.py:42-82 pools fv globally), diluting the scale features
+  everywhere — padding sensitivity is a property of the WEIGHTS, not
+  just the architecture.
+
+Measured (8-device CPU mesh, pad_multiple=64 — coarser than the auto
+ladder would pick):
+
+* fresh init (zero biases):        relative MAE delta = 0 (exact);
+* 3-epoch lightly trained model:   ~3e-6 relative (biases still tiny);
+* bias-perturbed model (+0.05, a stand-in for a fully trained net whose
+  VGG frontend has real biases): ~0.2% relative count delta.
+
+Decision: a fully trained net is exactly the paper-parity use case, and
+0.2% is above the 0.1% negligibility bar — so ``exact`` stays the eval
+default; ``auto`` (+ remnant sub-batches) remains the opt-in speed mode
+for workflows that trade a sub-percent metric shift for the bounded
+compile bill.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu.data import CrowdDataset, ShardedBatcher, make_synthetic_dataset
+from can_tpu.models import cannet_apply, cannet_init
+from can_tpu.parallel import (
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_global_batch,
+    make_mesh,
+)
+from can_tpu.train import (
+    create_train_state,
+    evaluate,
+    make_lr_schedule,
+    make_optimizer,
+    train_one_epoch,
+)
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def trained_eval_setup(tmp_path_factory):
+    root = tmp_path_factory.mktemp("bucketed_eval")
+    img_root, gt_root = make_synthetic_dataset(
+        str(root / "train"), 16, sizes=((64, 64), (64, 96)), seed=11,
+        max_people=8)
+    test_sizes = ((64, 64), (64, 96), (96, 64), (96, 96), (64, 128),
+                  (128, 96))
+    test_img, test_gt = make_synthetic_dataset(
+        str(root / "test"), 12, sizes=test_sizes, seed=12, max_people=8)
+
+    mesh = make_mesh(jax.devices()[:8])
+    put = lambda b: make_global_batch(b, mesh)
+    train_ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="train")
+    train_b = ShardedBatcher(train_ds, 8, shuffle=True, seed=0)
+    opt = make_optimizer(make_lr_schedule(2e-6, world_size=8))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    step = make_dp_train_step(cannet_apply, opt, mesh)
+    for epoch in range(3):
+        state, _ = train_one_epoch(step, state, train_b.epoch(epoch),
+                                   put_fn=put, epoch=epoch,
+                                   show_progress=False)
+
+    ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test")
+    ev = make_dp_eval_step(cannet_apply, mesh)
+
+    def run(pad_multiple):
+        b = ShardedBatcher(ds, 8, shuffle=False, pad_multiple=pad_multiple)
+        return evaluate(ev, state.params, b.epoch(0), put_fn=put,
+                        dataset_size=b.dataset_size)
+
+    return run
+
+
+def test_bucketed_eval_delta_small_on_lightly_trained_model(trained_eval_setup):
+    exact = trained_eval_setup(None)
+    padded = trained_eval_setup(64)
+    rel = abs(padded["mae"] - exact["mae"]) / max(exact["mae"], 1e-9)
+    # a lightly trained model (biases still near zero) must sit far below
+    # the 0.1% negligibility bar; >10% would mean masking broke outright
+    assert rel < 0.001, (exact["mae"], padded["mae"])
+    print(f"\n[bucketed-eval] trained: exact MAE={exact['mae']:.6f} "
+          f"padded MAE={padded['mae']:.6f} rel_delta={rel:.3e}")
+
+
+def test_padding_sensitivity_exists_with_real_biases():
+    """The reason 'exact' stays the default: with nonzero biases (any
+    fully trained net) the padded canvas is no longer invisible — the
+    context block's global pooling sees it."""
+    params = cannet_init(jax.random.key(0))
+
+    def bump(p):
+        return {k: (v + 0.05 if k == "b" else v) for k, v in p.items()}
+
+    params = {"frontend": [bump(p) for p in params["frontend"]],
+              "backend": [bump(p) for p in params["backend"]],
+              "context": params["context"],
+              "output": bump(params["output"])}
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(1, 64, 96, 3)), jnp.float32)
+    xp = jnp.zeros((1, 128, 128, 3), jnp.float32).at[:, :64, :96, :].set(x)
+    y = cannet_apply(params, x)
+    yp = cannet_apply(params, xp)[:, :8, :12, :]
+    rel_count = abs(float(yp.sum() - y.sum())) / max(abs(float(y.sum())), 1e-9)
+    # measured ~0.19%: nonzero (the architecture is NOT padding-invariant
+    # once biases are real) but bounded
+    assert 1e-4 < rel_count < 0.05, rel_count
+
+
+def test_zero_bias_padding_exactly_invariant():
+    """Counter-probe: with zero biases (fresh init) padding is invisible —
+    zeros stay zeros through conv/relu/pool, so the delta is pure float
+    noise.  (This is why a fresh-init measurement of the question is
+    degenerate — the first version of this test fell for it.)"""
+    params = cannet_init(jax.random.key(0))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(1, 64, 96, 3)), jnp.float32)
+    xp = jnp.zeros((1, 128, 128, 3), jnp.float32).at[:, :64, :96, :].set(x)
+    y = cannet_apply(params, x)
+    yp = cannet_apply(params, xp)[:, :8, :12, :]
+    assert float(jnp.max(jnp.abs(y - yp))) < 1e-8
+
+
+def test_bucketed_eval_is_deterministic(trained_eval_setup):
+    a = trained_eval_setup(64)
+    b = trained_eval_setup(64)
+    assert a["mae"] == b["mae"] and a["mse"] == b["mse"]

@@ -52,6 +52,21 @@ GOLDEN_MAE = {
              17.2430, 16.4778, 15.9605, 15.4432, 14.9572],
 }
 
+# platform the goldens were recorded on.  f32 is portable at 1%; bf16 on
+# the CPU backend goes through truncation emulation whose conv-algorithm
+# choices vary across jaxlib versions/architectures, so off the pinned
+# platform its band widens instead of flaking (advisor r3)
+GOLDEN_JAXLIB = ("0.9.0", "x86_64")
+
+
+def _bf16_rtol():
+    import platform
+
+    import jaxlib
+
+    pinned = (jaxlib.__version__, platform.machine()) == GOLDEN_JAXLIB
+    return 0.01 if pinned else 0.05
+
 
 @pytest.mark.parametrize("tag", ["f32", "bf16"])
 def test_golden_convergence(tmp_path, tag):
@@ -85,7 +100,8 @@ def test_golden_convergence(tmp_path, tag):
 
     assert np.isfinite(maes).all()
     # the committed golden trajectory reproduces, epoch by epoch
-    np.testing.assert_allclose(maes, GOLDEN_MAE[tag], rtol=0.01,
+    rtol = 0.01 if tag == "f32" else _bf16_rtol()
+    np.testing.assert_allclose(maes, GOLDEN_MAE[tag], rtol=rtol,
                                err_msg=f"{tag} trajectory drifted: {maes}")
     # and the hard floor: final error meaningfully below the first epoch's
     assert maes[-1] < 0.75 * maes[0], maes

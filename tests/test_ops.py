@@ -133,3 +133,49 @@ def test_max_pool_matches_torch(hw):
         .numpy()
     )
     np.testing.assert_allclose(got, want)
+
+
+class TestSpaceToDepthStem:
+    """fold_stem_kernel: the packed stem conv must be numerically identical
+    to the plain 3x3 SAME conv (VERDICT r3 item 2 requires the fold be
+    parity-tested, not assumed)."""
+
+    def test_s2d_roundtrip(self):
+        from can_tpu.ops.conv import depth_to_space, space_to_depth
+
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 12, 5)),
+                        jnp.float32)
+        np.testing.assert_array_equal(depth_to_space(space_to_depth(x)), x)
+
+    @pytest.mark.parametrize("hw", [(8, 8), (16, 24), (10, 14)])
+    def test_folded_conv_matches_plain(self, hw):
+        from can_tpu.ops.conv import (
+            conv2d,
+            depth_to_space,
+            fold_stem_kernel,
+            space_to_depth,
+        )
+
+        rng = np.random.default_rng(1)
+        h, w = hw
+        x = jnp.asarray(rng.normal(size=(2, h, w, 3)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(3, 3, 3, 64)) * 0.1, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(64,)) * 0.01, jnp.float32)
+        want = conv2d(x, k, b)
+        kp, bp = fold_stem_kernel(k, b)
+        got = depth_to_space(conv2d(space_to_depth(x), kp, bp))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=1e-5)
+
+    def test_full_model_forward_identical(self):
+        from can_tpu.models import cannet_apply, cannet_init
+
+        import jax
+
+        params = cannet_init(jax.random.key(3))
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 32, 48, 3)),
+                        jnp.float32)
+        plain = cannet_apply(params, x)
+        packed = cannet_apply(params, x, s2d_stem=True)
+        np.testing.assert_allclose(np.asarray(packed), np.asarray(plain),
+                                   atol=1e-4, rtol=1e-4)

@@ -1,0 +1,141 @@
+"""Repeatable real-chip convergence benchmark (VERDICT r3 item 4).
+
+Round 3's real-TPU end-to-end CLI run (60 synthetic images at the Part-A
+shape histogram, ``--bf16 --u8-input``, 6 epochs, MAE 18.99 -> 10.06)
+existed only as a log in git history.  This scripts it: one command
+re-runs the exact recipe on the chip and checks the per-epoch eval-MAE
+trajectory against the committed golden band below — the TPU-side
+convergence regression net the CPU-mesh goldens (tests/test_golden.py)
+can't provide.
+
+Run (single process, real TPU):
+    python tools/bench_convergence.py            # check against golden
+    python tools/bench_convergence.py --record   # print fresh goldens
+CPU smoke: add ``--platform cpu --scale 0.125`` (no golden check — the
+TPU goldens don't transfer across backends; the run must still converge).
+
+Output: one JSON line, merged into BENCH_SUITE_r{N}.json by the round
+notes.  The quality bar this stands in for is the reference's
+checkpoint-backed dataset claim (reference README.md:37, test.py:69).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+from contextlib import redirect_stdout
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.rehearse_part_a import PART_A_SHAPES, _scaled_sizes  # noqa: E402
+
+# Committed golden trajectory: eval MAE per epoch, measured on the real
+# v5e chip (bf16 compute, u8 input, batch 8, lr 2e-6, seed 0).  TPU
+# execution is deterministic for a fixed program, but bucket-shape
+# scheduling and bf16 accumulation leave sub-percent run-to-run drift;
+# the band is set 10x above observed drift (see --record runs in
+# CHANGES.md round 4).
+GOLDEN_TPU_MAES = [9.9414, 8.4089, 7.2786, 6.6503, 6.3882, 6.3417]
+GOLDEN_RTOL = 0.02
+
+N_TRAIN, N_TEST = 60, 16
+EPOCHS, BATCH, LR, SEED = 6, 8, 2e-6, 0
+
+
+def run(root: str, *, platform: str = "default", scale: float = 1.0) -> dict:
+    from can_tpu.cli.train import main as train_main
+    from can_tpu.data import make_synthetic_dataset
+
+    sizes = _scaled_sizes(scale)
+    for split, n, s in (("train", N_TRAIN, SEED), ("test", N_TEST, SEED + 1)):
+        make_synthetic_dataset(os.path.join(root, f"{split}_data"), n,
+                               sizes=sizes, seed=s)
+    ckdir = os.path.join(root, "checkpoints")
+    argv = ["--data_root", root, "--epochs", str(EPOCHS),
+            "--batch-size", str(BATCH), "--lr", str(LR),
+            "--seed", str(SEED), "--bf16", "--u8-input",
+            "--checkpoint-dir", ckdir, "--eval-interval", "1"]
+    if platform != "default":
+        argv += ["--platform", platform]
+
+    buf = io.StringIO()
+
+    class Tee(io.TextIOBase):
+        def write(self, s):
+            buf.write(s)
+            sys.__stdout__.write(s)
+            return len(s)
+
+    t0 = time.perf_counter()
+    with redirect_stdout(Tee()):
+        rc = train_main(argv)
+    wall = time.perf_counter() - t0
+    if rc != 0:
+        raise RuntimeError(f"train CLI failed rc={rc}")
+    maes = [float(m) for m in re.findall(r"\bmae=([0-9.eE+-]+)",
+                                         buf.getvalue())]
+    if len(maes) != EPOCHS:
+        raise RuntimeError(f"expected {EPOCHS} eval MAEs, parsed {maes}")
+    return {"maes": maes, "wall_s": round(wall, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default="",
+                    help="work dir (default: fresh temp dir, removed after)")
+    ap.add_argument("--platform", default="default",
+                    choices=["default", "cpu", "tpu"])
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="shape-histogram scale (0.125 for CPU smoke)")
+    ap.add_argument("--record", action="store_true",
+                    help="print the measured trajectory as a new golden "
+                         "instead of checking")
+    args = ap.parse_args()
+
+    root = args.root or tempfile.mkdtemp(prefix="can_tpu_conv_bench_")
+    try:
+        res = run(root, platform=args.platform, scale=args.scale)
+    finally:
+        if not args.root:
+            shutil.rmtree(root, ignore_errors=True)
+
+    maes = res["maes"]
+    converged = bool(min(maes[1:]) < 0.75 * maes[0])
+    on_tpu_recipe = args.platform != "cpu" and args.scale == 1.0
+    if args.record:
+        print(f"GOLDEN_TPU_MAES = {[round(m, 4) for m in maes]}")
+        ok = converged
+        drift = None
+    elif on_tpu_recipe:
+        drift = float(np.max(np.abs(np.array(maes) / np.array(GOLDEN_TPU_MAES)
+                                    - 1.0)))
+        ok = converged and drift <= GOLDEN_RTOL
+    else:
+        drift = None
+        ok = converged  # cross-backend: convergence gate only
+    print(json.dumps({
+        "metric": "convergence_tpu_part_a_histogram",
+        "value": round(min(maes), 4),
+        "unit": "MAE (synthetic, lower=better)",
+        "maes": [round(m, 4) for m in maes],
+        "golden_ok": ok,
+        "golden_rtol": GOLDEN_RTOL if drift is not None else None,
+        "max_drift": round(drift, 5) if drift is not None else None,
+        "wall_s": res["wall_s"],
+        "recipe": {"n_train": N_TRAIN, "epochs": EPOCHS, "batch": BATCH,
+                   "lr": LR, "flags": "--bf16 --u8-input", "seed": SEED},
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
