@@ -41,6 +41,11 @@ BASELINE_IMG_PER_S_H100 = 25.0
 
 
 def main() -> None:
+    # fail fast if backend acquisition hangs (dead tunnel) — one stderr
+    # line and exit 3 beats a silently hung driver
+    from can_tpu.utils import await_devices
+
+    await_devices()
     import jax
     import jax.numpy as jnp
 

@@ -90,8 +90,9 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax  # noqa: F811
 
-    from can_tpu.utils import enable_compilation_cache
+    from can_tpu.utils import await_devices, enable_compilation_cache
 
+    await_devices()  # fail fast on a dead tunnel instead of hanging
     enable_compilation_cache()
 
     ndev = jax.device_count()

@@ -350,6 +350,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from can_tpu.utils import await_devices
+
+    await_devices()  # fail fast on a dead tunnel instead of hanging
     import jax  # noqa: F811
     import jax.numpy as jnp
 

@@ -2,7 +2,7 @@ from .checkpoint import CheckpointManager
 from .compile_cache import default_cache_dir, enable_compilation_cache
 from .logging import MetricLogger
 from .viz import save_density_visualization
-from .profiling import StepTimer, profile_trace
+from .profiling import StepTimer, await_devices, device_watchdog, profile_trace
 
 __all__ = [
     "CheckpointManager",
@@ -12,4 +12,6 @@ __all__ = [
     "profile_trace",
     "enable_compilation_cache",
     "default_cache_dir",
+    "await_devices",
+    "device_watchdog",
 ]

@@ -7,16 +7,55 @@ ABSENT" — only tqdm bars).  TPU-first observability:
   Perfetto (device timelines, HLO ops, ICI collectives);
 * ``StepTimer`` measures steady-state step time with an explicit
   ``block_until_ready`` fence — the JAX analogue of the reference's
-  ``cuda.synchronize`` timing hygiene (utils/train_eval_utils.py:55-57).
+  ``cuda.synchronize`` timing hygiene (utils/train_eval_utils.py:55-57);
+* ``device_watchdog`` / ``await_devices`` fail fast when backend
+  acquisition hangs (a dead accelerator tunnel blocks ``jax.devices()``
+  forever — round-4 incident).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from typing import Optional
 
 import jax
+
+
+def device_watchdog(seconds: float = 300.0):
+    """Fail FAST if JAX backend/device acquisition hangs.
+
+    A dead accelerator tunnel makes ``jax.devices()`` block forever with
+    no output — a silently hung benchmark/driver process.  Arm this
+    BEFORE the first backend touch and ``.set()`` the returned event
+    right after ``jax.devices()`` returns; if it isn't set within
+    ``seconds`` the process prints one clear stderr line and exits 3.
+    Generous default: a cold tunnel handshake is legitimately slow.
+    """
+    armed = threading.Event()
+
+    def boom():
+        if not armed.wait(seconds):
+            import sys
+
+            print(f"[watchdog] FATAL: no JAX device within {seconds:.0f}s "
+                  f"— accelerator backend unreachable", file=sys.stderr,
+                  flush=True)
+            os._exit(3)
+
+    threading.Thread(target=boom, daemon=True).start()
+    return armed
+
+
+def await_devices(seconds: float = 300.0):
+    """Arm the watchdog, force backend init, disarm; returns devices.
+    One call at the top of every benchmark entry point."""
+    armed = device_watchdog(seconds)
+    devices = jax.devices()
+    armed.set()
+    return devices
 
 
 @contextlib.contextmanager

@@ -6,7 +6,10 @@ existed only as a log in git history.  This scripts it: one command
 re-runs the exact recipe on the chip and checks the per-epoch eval-MAE
 trajectory against the committed golden band below — the TPU-side
 convergence regression net the CPU-mesh goldens (tests/test_golden.py)
-can't provide.
+can't provide.  UNTIL a ``--record`` run on a live chip commits the
+trajectory (GOLDEN_TPU_MAES below is None — the r4 recording attempt
+was cut short by the tunnel outage), the check degrades to the loose
+convergence gate and reports ``golden_ok: null``.
 
 Run (single process, real TPU):
     python tools/bench_convergence.py            # check against golden
@@ -39,12 +42,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.rehearse_part_a import PART_A_SHAPES, _scaled_sizes  # noqa: E402
 
 # Committed golden trajectory: eval MAE per epoch, measured on the real
-# v5e chip (bf16 compute, u8 input, batch 8, lr 2e-6, seed 0).  TPU
-# execution is deterministic for a fixed program, but bucket-shape
-# scheduling and bf16 accumulation leave sub-percent run-to-run drift;
-# the band is set 10x above observed drift (see --record runs in
-# CHANGES.md round 4).
-GOLDEN_TPU_MAES = [9.9414, 8.4089, 7.2786, 6.6503, 6.3882, 6.3417]
+# v5e chip (bf16 compute, u8 input, batch 8, lr 2e-6, seed 0).  None =
+# NOT YET RECORDED — the r4 recording run was cut short when the dev
+# tunnel died mid-round (CHANGES.md); until a `--record` run on a chip
+# fills this in, the check degrades to the convergence gate alone and
+# says so in its output.  TPU execution is deterministic for a fixed
+# program, but bucket-shape scheduling and bf16 accumulation leave
+# sub-percent run-to-run drift; the band is 10x above expected drift.
+GOLDEN_TPU_MAES = None
 GOLDEN_RTOL = 0.02
 
 N_TRAIN, N_TEST = 60, 16
@@ -101,6 +106,12 @@ def main() -> int:
                          "instead of checking")
     args = ap.parse_args()
 
+    if args.platform != "cpu":
+        # fail fast on a dead tunnel instead of hanging (CPU runs must
+        # NOT touch the default backend before --platform cpu applies)
+        from can_tpu.utils import await_devices
+
+        await_devices()
     root = args.root or tempfile.mkdtemp(prefix="can_tpu_conv_bench_")
     try:
         res = run(root, platform=args.platform, scale=args.scale)
@@ -111,23 +122,30 @@ def main() -> int:
     maes = res["maes"]
     converged = bool(min(maes[1:]) < 0.75 * maes[0])
     on_tpu_recipe = args.platform != "cpu" and args.scale == 1.0
+    drift = None
     if args.record:
         print(f"GOLDEN_TPU_MAES = {[round(m, 4) for m in maes]}")
         ok = converged
-        drift = None
-    elif on_tpu_recipe:
+    elif on_tpu_recipe and GOLDEN_TPU_MAES is not None:
         drift = float(np.max(np.abs(np.array(maes) / np.array(GOLDEN_TPU_MAES)
                                     - 1.0)))
         ok = converged and drift <= GOLDEN_RTOL
     else:
-        drift = None
-        ok = converged  # cross-backend: convergence gate only
+        # cross-backend run, or golden not yet recorded: convergence gate
+        if on_tpu_recipe:
+            print("# no golden recorded yet — run with --record on a chip "
+                  "and commit the trajectory", file=sys.stderr, flush=True)
+        ok = converged
+    golden_checked = drift is not None
     print(json.dumps({
         "metric": "convergence_tpu_part_a_histogram",
         "value": round(min(maes), 4),
         "unit": "MAE (synthetic, lower=better)",
         "maes": [round(m, 4) for m in maes],
-        "golden_ok": ok,
+        "converged": converged,
+        # null until a --record golden exists: 'true' must only ever mean
+        # the committed trajectory reproduced within the band
+        "golden_ok": ok if golden_checked else None,
         "golden_rtol": GOLDEN_RTOL if drift is not None else None,
         "max_drift": round(drift, 5) if drift is not None else None,
         "wall_s": res["wall_s"],

@@ -76,6 +76,9 @@ def main():
     from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
     from can_tpu.utils import enable_compilation_cache
 
+    from can_tpu.utils import await_devices
+
+    await_devices()  # fail fast on a dead tunnel instead of hanging
     enable_compilation_cache()
     import jax
     import jax.numpy as jnp

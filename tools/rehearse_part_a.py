@@ -163,6 +163,12 @@ def main() -> int:
     if args.epochs < 2:
         ap.error("--epochs must be >= 2 (the success gate needs a later "
                  "epoch to compare against the first)")
+    if args.platform != "cpu":
+        # fail fast on a dead tunnel instead of hanging (CPU runs must
+        # not touch the default backend before --platform cpu applies)
+        from can_tpu.utils import await_devices
+
+        await_devices()
     res = run(args.root, epochs=args.epochs, scale=args.scale,
               platform=args.platform, lr=args.lr)
     print(f"[rehearsal] eval MAEs per epoch: {res['maes']}")
