@@ -51,11 +51,15 @@ def device_watchdog(seconds: float = 300.0):
 
 def await_devices(seconds: float = 300.0):
     """Arm the watchdog, force backend init, disarm; returns devices.
-    One call at the top of every benchmark entry point."""
+    One call at the top of every benchmark entry point.  Disarms in
+    ``finally``: a backend that RAISES (refused connection) instead of
+    hanging must not leave the timer to kill the caller's fallback path
+    minutes later."""
     armed = device_watchdog(seconds)
-    devices = jax.devices()
-    armed.set()
-    return devices
+    try:
+        return jax.devices()
+    finally:
+        armed.set()
 
 
 @contextlib.contextmanager

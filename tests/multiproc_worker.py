@@ -6,11 +6,16 @@ rendezvous, lockstep ShardedBatcher, make_array_from_process_local_data —
 and writes the final loss to a file.
 
 Modes:
-  dp    8-way data parallel (the reference's only configuration)
-  dpsp  dp=2 x sp=4 — each process's 4 local devices jointly hold ONE
-        replica's H-sharded activations (halo-exchange convs + psum'd
-        pooling inside, gradient psum over both axes) — the configuration
-        a real pod runs for big images
+  dp       8-way data parallel (the reference's only configuration)
+  dpsp     dp=2 x sp=4 — each process's 4 local devices jointly hold ONE
+           replica's H-sharded activations (halo-exchange convs + psum'd
+           pooling inside, gradient psum over both axes) — the
+           configuration a real pod runs for big images
+  remnant  dp=8 over a VARIABLE-resolution dataset with the auto bucket
+           ladder + remnant sub-batches (batch_quantum = lcm(dp, nprocs)):
+           the r4 planner's lockstep contract — every host derives the
+           same (shape x size) schedule incl. sub-full launches — proven
+           across real OS-process boundaries
 
 Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir> [mode]
 """
@@ -65,7 +70,31 @@ def main():
                       gt_downsample=8, phase="train")
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
     state = create_train_state(cannet_init(jax.random.key(0)), opt)
-    if mode == "dpsp":
+    if mode == "remnant":
+        import math
+
+        mesh = make_mesh()
+        dp = 4 * nprocs
+        # max_buckets BELOW the distinct-shape count so the auto policy
+        # actually builds a ladder (it prefers exact shapes when they fit
+        # the budget, and exact mode never emits remnant sub-batches)
+        common = dict(shuffle=True, seed=3, process_index=rank,
+                      process_count=nprocs, pad_multiple="auto",
+                      max_buckets=2, remnant_sizes=True,
+                      batch_quantum=math.lcm(dp, nprocs),
+                      launch_cost_px=0)  # free launches: max sub-batching
+        batcher = ShardedBatcher(ds, 16 // nprocs, **common)
+        # the plan must actually exercise a sub-full launch, else this
+        # test proves nothing
+        assert any(len(g) < 16 for _, g in batcher.global_schedule(0)), (
+            "remnant mode scheduled only full batches")
+        step = make_dp_train_step(cannet_apply, opt, mesh)
+        eval_step = make_dp_eval_step(cannet_apply, mesh)
+        put = lambda b: make_global_batch(b, mesh)
+        # worker per-host eval_bs = reference global (8) // nprocs, so the
+        # eval schedule is the SAME plan the single-process reference runs
+        eval_bs = 8 // nprocs
+    elif mode == "dpsp":
         # dp = nprocs, sp = 4: each process's local devices hold one
         # replica; the (64, 64) synthetic images H-shard into 4 x 16 rows
         mesh = make_mesh(dp=nprocs, sp=4)

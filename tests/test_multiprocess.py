@@ -102,7 +102,20 @@ def _single_process_reference(tmp_path, mode: str):
                       gt_downsample=8, phase="train")
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=8))
     state = create_train_state(cannet_init(jax.random.key(0)), opt)
-    if mode == "dpsp":
+    if mode == "remnant":
+        import math
+
+        mesh = make_mesh(jax.devices()[:8])
+        batcher = ShardedBatcher(ds, 16, shuffle=True, seed=3,
+                                 pad_multiple="auto", max_buckets=2,
+                                 remnant_sizes=True,
+                                 batch_quantum=math.lcm(8, 1),
+                                 launch_cost_px=0)
+        step = make_dp_train_step(cannet_apply, opt, mesh)
+        eval_step = make_dp_eval_step(cannet_apply, mesh)
+        put = lambda b: make_global_batch(b, mesh)
+        eval_bs = 8
+    elif mode == "dpsp":
         mesh = make_mesh(jax.devices()[:8], dp=2, sp=4)
         batcher = ShardedBatcher(ds, 4, shuffle=True, seed=3)
         step = make_sp_train_step(opt, mesh, (64, 64))
@@ -143,5 +156,19 @@ def test_two_process_dpsp_training_agrees(tmp_path):
                            sizes=((64, 64),), seed=3)
     losses, mae = _run_two_procs(tmp_path, "dpsp")
     want_loss, want_mae = _single_process_reference(tmp_path, "dpsp")
+    assert losses[0] == pytest.approx(want_loss, rel=1e-4)
+    assert mae == pytest.approx(want_mae, rel=1e-4)
+
+
+def test_two_process_remnant_schedule_agrees(tmp_path):
+    """r4 planner across real OS-process boundaries: a variable-resolution
+    dataset under the auto ladder + remnant sub-batches (incl. sub-full
+    launches — the worker asserts one occurs) must train in lockstep and
+    match the single-process run batch for batch."""
+    make_synthetic_dataset(
+        str(tmp_path / "data"), 20,
+        sizes=((64, 64), (64, 96), (96, 64), (96, 96)), seed=3)
+    losses, mae = _run_two_procs(tmp_path, "remnant")
+    want_loss, want_mae = _single_process_reference(tmp_path, "remnant")
     assert losses[0] == pytest.approx(want_loss, rel=1e-4)
     assert mae == pytest.approx(want_mae, rel=1e-4)

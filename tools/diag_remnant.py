@@ -74,9 +74,7 @@ def main():
     from can_tpu.models import cannet_apply, cannet_init
     from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
     from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
-    from can_tpu.utils import enable_compilation_cache
-
-    from can_tpu.utils import await_devices
+    from can_tpu.utils import await_devices, enable_compilation_cache
 
     await_devices()  # fail fast on a dead tunnel instead of hanging
     enable_compilation_cache()
