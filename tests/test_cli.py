@@ -307,3 +307,60 @@ class TestRematPolicy:
         # smoke invocation) still means "on"
         args = parse_args(["--remat", "--bf16"])
         assert args.remat == "on" and args.bf16
+
+
+class TestDeviceWatchdog:
+    """utils.device_watchdog: the dead-tunnel fail-fast (r4 incident —
+    jax.devices() can block forever when the accelerator link dies)."""
+
+    def test_disarm_path(self):
+        from can_tpu.utils import await_devices
+
+        assert len(await_devices(30)) >= 1  # CPU backend answers fast
+
+    def test_fires_and_exits_3(self):
+        # firing path needs its own process (the watchdog os._exit's)
+        import subprocess
+        import sys
+
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import time\n"
+            "from can_tpu.utils import device_watchdog\n"
+            "device_watchdog(1.0)\n"
+            "time.sleep(30)\n"  # simulate a hung backend acquisition
+            "print('should never get here')\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=25)
+        assert proc.returncode == 3, (proc.returncode, proc.stderr)
+        assert "watchdog" in proc.stderr
+        assert "should never" not in proc.stdout
+
+    def test_disarms_on_exception(self):
+        # a backend that RAISES (refused connection) must not leave the
+        # timer to kill the caller's fallback path later (code-review
+        # r4).  Run in a subprocess and drive await_devices itself with
+        # jax.devices monkeypatched to raise: if the finally-disarm
+        # regresses, the timer os._exit(3)s the child (not pytest) and
+        # the 'survived' marker never prints.
+        import subprocess
+        import sys
+
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import time\n"
+            "import can_tpu.utils.profiling as prof\n"
+            "prof.jax.devices = lambda: (_ for _ in ()).throw("
+            "RuntimeError('refused'))\n"
+            "try:\n"
+            "    prof.await_devices(1.0)\n"
+            "except RuntimeError as e:\n"
+            "    assert 'refused' in str(e)\n"
+            "time.sleep(1.5)\n"  # a still-armed timer would exit 3 here
+            "print('survived')\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=25)
+        assert proc.returncode == 0, (proc.returncode, proc.stderr)
+        assert "survived" in proc.stdout

@@ -158,7 +158,13 @@ def main() -> int:
                     help="shape-histogram scale (0.125 for CPU smoke)")
     ap.add_argument("--platform", default="default",
                     choices=["default", "cpu", "tpu"])
-    ap.add_argument("--lr", type=float, default=2e-6)
+    ap.add_argument("--lr", type=float, default=2e-6,
+                    help="default tuned for --scale 0.125. The MSE-sum "
+                         "loss makes gradients grow with pixel count, so "
+                         "scale the lr DOWN as --scale goes up (measured: "
+                         "2e-6 diverges at scale 0.25; 5e-7 converges); "
+                         "at full scale use ~1e-7 like the reference "
+                         "(train.py:177)")
     args = ap.parse_args()
     if args.epochs < 2:
         ap.error("--epochs must be >= 2 (the success gate needs a later "
