@@ -168,10 +168,24 @@ def main(argv=None) -> int:
             # per-item padding, which a smaller batch would NOT fix)
             fill = (1 + sched) / (1 + pad) - 1
             if fill > 0.5:
-                print(f"[data] hint: batch fill slots add {fill:.0%} "
-                      "compute (small eval set spread over many shapes at "
-                      "this batch size) — a smaller --batch-size will "
-                      "evaluate faster")
+                if not args.no_remnant_batches:
+                    # remnant covers already shrank every launch to the
+                    # smallest legal size, so what remains is the batch
+                    # quantum: each launch must split across the dp mesh
+                    # axis and every host
+                    print(f"[data] hint: batch fill slots add {fill:.0%} "
+                          f"compute — the per-launch floor is "
+                          f"{batcher.batch_quantum} images "
+                          f"(lcm of dp={dp} and {process_count()} "
+                          f"host(s)); a tiny eval set can't fill it "
+                          f"(evaluate on fewer devices to lower the "
+                          f"floor)")
+                else:
+                    print(f"[data] hint: batch fill slots add {fill:.0%} "
+                          "compute (small eval set spread over many "
+                          "shapes at this batch size) — drop "
+                          "--no-remnant-batches or use a smaller "
+                          "--batch-size")
         if args.sp > 1:
             eval_step = make_cached_sp_eval_step(mesh,
                                                  compute_dtype=compute_dtype)

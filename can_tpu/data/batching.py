@@ -650,7 +650,7 @@ class ShardedBatcher:
         else:
             order = np.arange(n)
         gbs = self.batch_size * self.process_count
-        remnant_mode = self.bucket_ladder is not None and self.remnant_sizes
+        remnant_mode = self.remnant_sizes
         menu = self._remnant_menu() if remnant_mode else None
         full_size = {}  # per-cell full-batch size (pixel cap may shrink it)
 
@@ -691,6 +691,31 @@ class ShardedBatcher:
                             take = take + [(take[0][0], False)] * (size - len(take))
                         schedule.append((join_key, take))
                 return schedule
+        if self.bucket_ladder is None and self.remnant_sizes:
+            # exact / fixed-multiple modes: remnant sizes WITHOUT merging,
+            # COVER-ONLY (a single part per straggler group: the smallest
+            # menu size that fits it).  Shape joins would break these
+            # modes' padding promises, and a multi-part split would mint
+            # extra (shape, size) programs — cover-only keeps both
+            # invariants: exactly legacy's launch and program counts, with
+            # the (shape, cover) program replacing (shape, gbs).  This is
+            # what makes small-eval-set batch>1 eval cheap: the reference
+            # evaluates at batch 1 with zero waste (test.py:16-35); a
+            # 16-image eval split at batch 8 used to be ~70% fill slots
+            # here (the round-3 startup hint).
+            for key, group in sorted(((k, g) for k, g in pending.items()
+                                      if g), key=lambda kg: kg[0]):
+                fits = [s for s in self._menu_for(key, menu)
+                        if s >= len(group)]
+                size = min(fits) if fits else max(self._menu_for(key, menu))
+                pos = 0
+                while pos < len(group):  # >1 round only under a pixel cap
+                    take = group[pos:pos + size]
+                    pos += size
+                    if len(take) < size:
+                        take = take + [(take[0][0], False)] * (size - len(take))
+                    schedule.append((key, take))
+            return schedule
         partials = sorted(((k, g) for k, g in pending.items() if g),
                           key=lambda kg: kg[0])
         if self.bucket_ladder is not None:
