@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import argparse
 import os
 from typing import Dict, Optional, Tuple
 
@@ -212,6 +213,79 @@ def make_remat_policy(remat_flag: str, *, global_batch: int,
 
     policy._said = set()
     return policy
+
+
+MODEL_MPX_PER_S = 42.0  # CANNet bf16 train-step device rate (v5e measured:
+# 94.9 img/s x 0.442 Mpx at 576x768) — converts dispatch ms to the
+# pixel-equivalents the remnant planner prices launches in
+
+
+def measure_launch_cost_mpx(*, probes: int = 30,
+                            device_rate_mpx_s: float = MODEL_MPX_PER_S) -> float:
+    """Measure per-launch dispatch overhead and convert to Mpx-equivalents
+    (the remnant planner's unit).  Times a tiny jitted op back-to-back:
+    each call pays the host->device dispatch path but near-zero compute,
+    so the median per-call time approximates the fixed launch cost (a
+    train step's is somewhat higher — more args to marshal — so this is
+    a mild underestimate; it still separates a ~50 ms tunnel from a
+    sub-ms local host, which is the decision that matters).  Costs one
+    trivial compile at startup.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros(())
+    x = f(x)
+    float(jax.device_get(x))  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(probes):
+        x = f(x)
+    float(jax.device_get(x))
+    per_call_s = (time.perf_counter() - t0) / probes
+    return per_call_s * device_rate_mpx_s
+
+
+def parse_launch_cost(value):
+    """argparse type for --launch-cost-mpx: 'auto' or a float — validated
+    AT PARSE TIME (a typo'd value must not cost a multi-host rendezvous,
+    same contract as the path checks)."""
+    s = str(value).strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        return float(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a number, got {value!r}")
+
+
+def resolve_launch_cost_px(spec, *, announce: bool = False) -> float:
+    """CLI --launch-cost-mpx value (parse_launch_cost output) -> planner
+    pixel units.  'auto' measures the host's dispatch overhead
+    (measure_launch_cost_mpx) and, on multi-host runs, averages it across
+    processes with ``reduce_value`` so every host prices launches
+    identically — the remnant planner's lockstep schedule depends on all
+    hosts computing the SAME plan.  A number is used as given (default
+    2.0 ~= the dev tunnel's measured ~50 ms/launch).  Call AFTER
+    init_runtime."""
+    if spec == "auto":
+        import numpy as _np
+
+        from can_tpu.parallel import process_count, reduce_value
+
+        mpx = measure_launch_cost_mpx()
+        if process_count() > 1:
+            mpx = float(reduce_value(_np.float32(mpx), average=True))
+        if announce:
+            print(f"[planner] measured launch overhead ~"
+                  f"{mpx / MODEL_MPX_PER_S * 1e3:.1f} ms/launch -> "
+                  f"launch cost {mpx:.2f} Mpx"
+                  + (" (mean across hosts)" if process_count() > 1 else ""))
+        return mpx * 1e6
+    return float(spec) * 1e6
 
 
 def make_bucketed_train_step(apply_fn, optimizer, mesh, *, compute_dtype,

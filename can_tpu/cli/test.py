@@ -18,6 +18,7 @@ from can_tpu.cli.common import (
     build_mesh_and_batch,
     make_cached_sp_eval_step,
     parse_pad_multiple,
+    resolve_launch_cost_px,
     resolve_split_roots,
     resolve_sp_padding,
 )
@@ -87,9 +88,12 @@ def parse_args(argv=None):
                    help="with --pad-multiple auto, pad straggler groups to "
                         "the full batch instead of emitting smaller "
                         "sub-batches (see train CLI)")
-    p.add_argument("--launch-cost-mpx", type=float, default=2.0,
+    from can_tpu.cli.common import parse_launch_cost
+
+    p.add_argument("--launch-cost-mpx", type=parse_launch_cost, default=2.0,
                    help="per-launch cost for the remnant planner, in "
-                        "megapixel-equivalents (see train CLI)")
+                        "megapixel-equivalents, or 'auto' to measure this "
+                        "host's dispatch overhead (see train CLI)")
     return p.parse_args(argv)
 
 
@@ -154,7 +158,8 @@ def main(argv=None) -> int:
                                  max_buckets=args.max_buckets,
                                  remnant_sizes=not args.no_remnant_batches,
                                  batch_quantum=_math.lcm(dp, process_count()),
-                                 launch_cost_px=args.launch_cost_mpx * 1e6)
+                                 launch_cost_px=resolve_launch_cost_px(
+                                     args.launch_cost_mpx))
         if process_index() == 0:
             # main-process-only: the telemetry re-scans every image header,
             # and a pod would otherwise emit one duplicate line per process

@@ -37,6 +37,7 @@ from can_tpu.cli.common import (
     make_cached_sp_eval_step,
     make_remat_policy,
     parse_pad_multiple,
+    resolve_launch_cost_px,
     resolve_split_roots,
     resolve_sp_padding,
 )
@@ -164,14 +165,17 @@ def parse_args(argv=None):
                         "slots; each (shape x size) program counts against "
                         "--max-buckets) instead of padding to the full "
                         "global batch")
-    p.add_argument("--launch-cost-mpx", type=float, default=2.0,
+    from can_tpu.cli.common import parse_launch_cost
+
+    p.add_argument("--launch-cost-mpx", type=parse_launch_cost, default=2.0,
                    help="fixed cost of one extra step launch, in "
                         "megapixel-equivalents, for the remnant planner's "
                         "pixels-vs-launches trade. The conservative "
                         "default (~50 ms at the chip's measured rate) "
-                        "suits high-dispatch-latency links; hosts with "
-                        "sub-ms dispatch should pass ~0.05 to unlock "
-                        "exact straggler splits")
+                        "suits high-dispatch-latency links; 'auto' "
+                        "measures this host's dispatch overhead at "
+                        "startup (sub-ms dispatch unlocks exact "
+                        "straggler splits)")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -241,7 +245,8 @@ def main(argv=None) -> int:
                   num_workers=num_workers, max_buckets=args.max_buckets,
                   remnant_sizes=not args.no_remnant_batches,
                   batch_quantum=quantum,
-                  launch_cost_px=args.launch_cost_mpx * 1e6)
+                  launch_cost_px=resolve_launch_cost_px(
+                      args.launch_cost_mpx, announce=main_proc))
     if not args.no_remnant_batches:
         # HBM cap per launch: bucket cells too big for the full global
         # batch run at a smaller menu size instead of OOMing (train only —

@@ -364,3 +364,28 @@ class TestDeviceWatchdog:
                               capture_output=True, text=True, timeout=25)
         assert proc.returncode == 0, (proc.returncode, proc.stderr)
         assert "survived" in proc.stdout
+
+
+class TestLaunchCostAuto:
+    def test_resolve_fixed_and_auto(self):
+        from can_tpu.cli.common import resolve_launch_cost_px
+
+        assert resolve_launch_cost_px("2.0") == pytest.approx(2e6)
+        assert resolve_launch_cost_px("0.05") == pytest.approx(5e4)
+        # auto measures this host's dispatch overhead: non-negative, and
+        # on a local CPU backend far below the 2 Mpx tunnel default
+        v = resolve_launch_cost_px("auto")
+        assert 0 <= v < 2e6
+
+    def test_cli_accepts_auto_and_validates_at_parse_time(self):
+        from can_tpu.cli.test import parse_args as eval_parse
+        from can_tpu.cli.train import parse_args
+
+        assert parse_args([]).launch_cost_mpx == 2.0
+        assert parse_args(["--launch-cost-mpx", "auto"]).launch_cost_mpx == "auto"
+        assert eval_parse(["--data_root", "/tmp",
+                           "--launch-cost-mpx", "auto"]).launch_cost_mpx == "auto"
+        # a typo'd value fails AT PARSE TIME (before any multi-host
+        # rendezvous), not as a raw ValueError mid-run
+        with pytest.raises(SystemExit):
+            parse_args(["--launch-cost-mpx", "2.o"])
