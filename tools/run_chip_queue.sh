@@ -1,0 +1,34 @@
+#!/bin/bash
+# The round-4 chip queue — everything that was blocked when the dev
+# tunnel died mid-round (CHANGES.md round-4 environment note).  Run on a
+# host with ONE live TPU attached (single process at a time!):
+#
+#   bash tools/run_chip_queue.sh [out_dir]
+#
+# Produces, in order:
+#  1. convergence golden, twice (drift check) -> paste the --record
+#     trajectory into tools/bench_convergence.py GOLDEN_TPU_MAES, commit;
+#  2. full-scale Part-A rehearsal (reference lr 1e-7 at full shapes);
+#  3. the varres re-measure + full bench sweep -> BENCH_SUITE_r{N}.json.
+# Each step fails fast on a dead backend (utils.await_devices).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/chip_queue_$(date +%H%M)}"
+mkdir -p "$OUT"
+echo "== chip queue -> $OUT"
+
+echo "== 1a. convergence --record (run 1)"
+python tools/bench_convergence.py --record | tee "$OUT/convergence_run1.txt"
+echo "== 1b. convergence --record (run 2, drift check)"
+python tools/bench_convergence.py --record | tee "$OUT/convergence_run2.txt"
+echo "   -> diff the GOLDEN_TPU_MAES lines; commit run 1's into"
+echo "      tools/bench_convergence.py if drift << 2%"
+
+echo "== 2. full-scale Part-A rehearsal (full shapes, reference lr)"
+python tools/rehearse_part_a.py --root "$OUT/rehearsal" --epochs 3 \
+    --scale 1.0 --lr 1e-7 | tee "$OUT/rehearsal.txt"
+
+echo "== 3. bench sweep (varres re-measure incl. b16 remat-auto cap)"
+python bench_suite.py | tee "$OUT/bench_suite.txt"
+
+echo "== queue done; artifacts in $OUT"
