@@ -136,13 +136,19 @@ def activation_bytes(batch: int, h: int, w: int, *,
 
 
 def device_memory_bytes() -> Optional[int]:
-    """Per-device HBM (bytes_limit), or None when the backend doesn't
+    """Per-LOCAL-device HBM (bytes_limit), or None when the backend doesn't
     report one (CPU).  Callers must treat None as 'no device memory
     ceiling' — inventing a number here would let a fictitious 16 GiB
     drive real scheduling (launch caps, remat, LR-schedule step counts)
-    on backends whose only limit is host RAM."""
+    on backends whose only limit is host RAM.
+
+    ``jax.local_devices()``, not ``jax.devices()``: on a multi-host pod
+    devices()[0] is non-addressable for every rank but 0, so its
+    memory_stats() fails there and ranks would silently diverge on
+    whether an HBM cap exists (ADVICE r4, high).  Multi-host callers
+    must still AGREE the value — use agreed_device_memory_bytes()."""
     try:
-        stats = jax.devices()[0].memory_stats()
+        stats = jax.local_devices()[0].memory_stats()
         if stats and stats.get("bytes_limit"):
             return int(stats["bytes_limit"])
     except Exception:
@@ -150,12 +156,42 @@ def device_memory_bytes() -> Optional[int]:
     return None
 
 
+def agreed_device_memory_bytes() -> Optional[int]:
+    """device_memory_bytes() agreed across processes (min), for anything
+    that feeds the LOCKSTEP schedule: every host must derive the same
+    max_launch_px / remat decisions or make_array_from_process_local_data
+    deadlocks on mismatched batch plans.  Min is the conservative
+    agreement; a host with no ceiling (None) forces None everywhere
+    (heterogeneous backends shouldn't invent a cap for the others).
+    Collective — call AFTER init_runtime, identically on every host."""
+    from can_tpu.parallel import agree_min_value, process_count
+
+    mem = device_memory_bytes()
+    if process_count() < 2:
+        return mem
+    import numpy as _np
+
+    agreed = float(agree_min_value(_np.float64(-1.0 if mem is None else mem)))
+    return None if agreed < 0 else int(agreed)
+
+
+_DETECT = object()  # sentinel: "autodetect HBM" vs an explicit None cap
+
+
 def max_launch_pixels(*, bf16: bool, ceiling_frac: float = 0.92,
-                      hbm_bytes: Optional[int] = None) -> Optional[float]:
-    """Per-launch pixel budget (batch * H * W) for the remnant planner's
-    HBM cap (ShardedBatcher max_launch_px), or None on backends with no
+                      hbm_bytes=_DETECT, shards: int = 1) -> Optional[float]:
+    """Per-launch pixel budget (batch * H * W, GLOBAL units — the planner
+    prices launches in global pixels) for the remnant planner's HBM cap
+    (ShardedBatcher max_launch_px), or None on backends with no
     device-memory ceiling (CPU) — there the cap would be fiction and
     would shift batch counts (hence LR schedules) vs the TPU run.
+
+    ``shards``: devices each launch is split across (mesh dp*sp).  The
+    train step shards the batch over dp and H over sp, so per-DEVICE
+    pixels = global pixels / shards; the B/px constant below is
+    per-device (calibrated at dp=sp=1), so the global cap scales by
+    ``shards`` — without this, a dp=4 pod would cap launches 4x smaller
+    than what fits (ADVICE r4, medium).
 
     Calibrated to the measured worst case, not the analytic optimum: even
     WITH remat, the b16 x 1016x1024 backward peaked at ~17.2 GiB for
@@ -164,19 +200,20 @@ def max_launch_pixels(*, bf16: bool, ceiling_frac: float = 0.92,
     ~1100 B/px (bf16; f32 doubles it) against ``ceiling_frac`` of HBM
     admits every configuration that has been seen to fit (b16 768x1024,
     b8 1016x1024) and rejects the one that OOM'd.  ``hbm_bytes``
-    overrides autodetection (tests pin it).
+    overrides autodetection (tests pin it; multi-host CLIs pass the
+    agreed_device_memory_bytes() value so every host caps identically).
     """
-    mem = hbm_bytes if hbm_bytes is not None else device_memory_bytes()
+    mem = device_memory_bytes() if hbm_bytes is _DETECT else hbm_bytes
     if mem is None:
         return None
     per_px = 1100.0 if bf16 else 2200.0
-    return ceiling_frac * mem / per_px
+    return ceiling_frac * mem / per_px * shards
 
 
 def make_remat_policy(remat_flag: str, *, global_batch: int,
                       bf16: bool, budget_frac: float = 0.80,
                       announce: bool = False,
-                      hbm_bytes: Optional[int] = None):
+                      hbm_bytes=_DETECT, shards: int = 1):
     """Per-bucket rematerialisation decision (VERDICT r3 item 3).
 
     ``--remat on`` / ``off`` force the choice globally; ``auto`` (default)
@@ -191,10 +228,16 @@ def make_remat_policy(remat_flag: str, *, global_batch: int,
     Returns ``policy(image_hw, batch=None) -> bool`` (batch defaults to the
     full global batch; remnant sub-batches pass their smaller actual size,
     so a big-shape straggler can still skip remat).
+
+    ``shards`` (mesh dp*sp): the footprint estimate is for the GLOBAL
+    launch but HBM is per-device and the step shards batch over dp / H
+    over sp, so the estimate is divided by ``shards`` before comparing —
+    otherwise dp>1 meshes over-trigger remat (ADVICE r4, medium).
+    Multi-host callers pass hbm_bytes=agreed_device_memory_bytes().
     """
     if remat_flag in ("on", "off"):
         return lambda hw, batch=None: remat_flag == "on"
-    mem = hbm_bytes if hbm_bytes is not None else device_memory_bytes()
+    mem = device_memory_bytes() if hbm_bytes is _DETECT else hbm_bytes
     if mem is None:
         # no device-memory ceiling reported (CPU backend): auto-remat
         # would be keyed to a made-up number — keep the fast backward
@@ -203,7 +246,7 @@ def make_remat_policy(remat_flag: str, *, global_batch: int,
 
     def policy(hw, batch=None):
         b = batch or global_batch
-        need = activation_bytes(b, hw[0], hw[1], bf16=bf16) > budget
+        need = activation_bytes(b, hw[0], hw[1], bf16=bf16) // shards > budget
         if need and announce and (b, hw) not in policy._said:
             policy._said.add((b, hw))
             print(f"[remat] bucket {hw[0]}x{hw[1]} (batch {b}): activation "
@@ -223,14 +266,32 @@ MODEL_MPX_PER_S = 42.0  # CANNet bf16 train-step device rate (v5e measured:
 def measure_launch_cost_mpx(*, probes: int = 30,
                             device_rate_mpx_s: float = MODEL_MPX_PER_S) -> float:
     """Measure per-launch dispatch overhead and convert to Mpx-equivalents
-    (the remnant planner's unit).  Times a tiny jitted op back-to-back:
-    each call pays the host->device dispatch path but near-zero compute,
-    so the median per-call time approximates the fixed launch cost (a
-    train step's is somewhat higher — more args to marshal — so this is
-    a mild underestimate; it still separates a ~50 ms tunnel from a
-    sub-ms local host, which is the decision that matters).  Costs one
-    trivial compile at startup.
+    (the remnant planner's unit).  Times a tiny jitted op, BLOCKING on
+    each call (device_get inside the loop): JAX enqueues dispatches ahead
+    of execution, so an unblocked loop would hide the per-launch
+    round-trip on exactly the high-latency tunnels 'auto' exists to
+    detect (ADVICE r4).  Each probe measures the full dispatch+completion
+    path with near-zero compute; the median is the fixed launch cost.
+    Note this is an UPPER bound on what the train loop pays per launch:
+    the loop fetches metrics once per ``check_every`` window (8 steps),
+    amortising the completion sync, while the dispatch-path cost (the
+    tunnel's measured ~50 ms RPC, r4 diag_remnant) is paid per launch
+    regardless — so on the hosts where 'auto' matters the bound is
+    tight, and elsewhere both numbers sit in the planner's flat region.
+
+    Calibration status (r5, tools/launch_cost_probe.py + the plan-space
+    sweep in CHANGES.md): the probe measures DISPATCH only; a real train
+    step also pays pixel-independent device work each launch — chiefly
+    the optimizer update (~300 MB of param/momentum traffic ≈ 0.4 ms ≈
+    0.015 Mpx-equivalents on v5e) plus argument marshaling.  That
+    omission cannot change a plan: the remnant planner's decisions are
+    flat across [0, 0.05] Mpx and across [1, 4] Mpx on the bench
+    distribution; the sensitive band (0.1-1 Mpx ≈ 2.5-25 ms) is exactly
+    where dispatch dominates and the probe measures the dominant term
+    directly.  So: no correction applied, by measurement rather than
+    hope.  Costs one trivial compile at startup.
     """
+    import statistics
     import time
 
     import jax
@@ -238,14 +299,13 @@ def measure_launch_cost_mpx(*, probes: int = 30,
 
     f = jax.jit(lambda x: x + 1.0)
     x = jnp.zeros(())
-    x = f(x)
-    float(jax.device_get(x))  # compile + settle
-    t0 = time.perf_counter()
+    float(jax.device_get(f(x)))  # compile + settle
+    times = []
     for _ in range(probes):
-        x = f(x)
-    float(jax.device_get(x))
-    per_call_s = (time.perf_counter() - t0) / probes
-    return per_call_s * device_rate_mpx_s
+        t0 = time.perf_counter()
+        float(jax.device_get(f(x)))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * device_rate_mpx_s
 
 
 def parse_launch_cost(value):

@@ -49,6 +49,14 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", type=str, default="./checkpoints")
     p.add_argument("--epoch", type=int, default=None,
                    help="checkpoint epoch (default: best by MAE, else latest)")
+    p.add_argument("--torch-pth", type=str, default="",
+                   help="evaluate a REFERENCE torch checkpoint directly "
+                        "(e.g. the published epoch_354.pth, reference "
+                        "test.py:69) — imported via utils/torch_import.py, "
+                        "no prior conversion needed")
+    p.add_argument("--params-npz", type=str, default="",
+                   help="evaluate a tools/import_torch_checkpoint.py .npz "
+                        "(torch-free path)")
     p.add_argument("--batch-size", type=int, default=1,
                    help="images per data-parallel replica")
     p.add_argument("--sp", type=int, default=1,
@@ -99,7 +107,19 @@ def parse_args(argv=None):
 
 def load_params(args):
     """Restore (params, batch_stats) from the checkpoint manager (best epoch
-    by default)."""
+    by default), or import reference/converted weights directly."""
+    if args.torch_pth or args.params_npz:
+        if args.torch_pth:
+            from can_tpu.utils.torch_import import load_torch_checkpoint
+
+            params = load_torch_checkpoint(args.torch_pth)
+            print(f"[load] reference torch checkpoint {args.torch_pth}")
+        else:
+            from can_tpu.utils.torch_import import load_params_npz
+
+            params = load_params_npz(args.params_npz)
+            print(f"[load] imported params {args.params_npz}")
+        return params, None
     params = cannet_init(jax.random.key(args.seed), batch_norm=args.syncBN)
     optimizer = make_optimizer(make_lr_schedule(1e-7))
     state = create_train_state(params, optimizer, init_batch_stats(params))
@@ -121,6 +141,16 @@ def main(argv=None) -> int:
     img_root, gt_root = resolve_split_roots(
         args.split, args.image_root, args.gt_root, args.data_root,
         flag_stem="")
+    import os as _os
+
+    if args.torch_pth and args.params_npz:
+        raise SystemExit("give --torch-pth OR --params-npz, not both")
+    if (args.torch_pth or args.params_npz) and args.syncBN:
+        raise SystemExit("--torch-pth/--params-npz hold the reference "
+                         "model (no BatchNorm); drop --syncBN")
+    for p in (args.torch_pth, args.params_npz):
+        if p and not _os.path.isfile(p):
+            raise SystemExit(f"no such checkpoint file: {p}")
     from can_tpu.cli.train import (
         apply_compile_cache,
         apply_platform,
@@ -139,6 +169,17 @@ def main(argv=None) -> int:
         # without this a multi-host pod would feed every image
         # process_count times
         mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
+        # params device-resident + replicated ONCE: the imported-checkpoint
+        # paths return host numpy trees, and feeding those to the jitted
+        # eval step would re-upload all ~74 MB of weights EVERY batch
+        # (review r5) — ruinous on a ~50 ms-dispatch tunnel.  No-op cost
+        # for the already-resident Orbax path.
+        from can_tpu.parallel import replicated_sharding
+
+        params = jax.device_put(params, replicated_sharding(mesh))
+        if batch_stats is not None:
+            batch_stats = jax.device_put(batch_stats,
+                                         replicated_sharding(mesh))
         pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
             args.pad_multiple, args.sp)
         if args.sp > 1 and pad_multiple != args.pad_multiple:

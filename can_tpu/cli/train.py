@@ -247,6 +247,14 @@ def main(argv=None) -> int:
                   batch_quantum=quantum,
                   launch_cost_px=resolve_launch_cost_px(
                       args.launch_cost_mpx, announce=main_proc))
+    # HBM agreed across hosts (min) ONCE: both the launch cap and the remat
+    # policy must be identical on every host or the lockstep schedule
+    # deadlocks (ADVICE r4 high — rank>0 reading a non-addressable device's
+    # stats used to silently get None while rank 0 got a cap)
+    from can_tpu.cli.common import agreed_device_memory_bytes
+
+    hbm = agreed_device_memory_bytes()
+    ndev = dp * args.sp  # devices per launch: batch shards over dp, H over sp
     if not args.no_remnant_batches:
         # HBM cap per launch: bucket cells too big for the full global
         # batch run at a smaller menu size instead of OOMing (train only —
@@ -254,7 +262,8 @@ def main(argv=None) -> int:
         from can_tpu.cli.common import max_launch_pixels
 
         train_common = dict(common,
-                            max_launch_px=max_launch_pixels(bf16=args.bf16))
+                            max_launch_px=max_launch_pixels(
+                                bf16=args.bf16, hbm_bytes=hbm, shards=ndev))
     else:
         train_common = common
     train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True,
@@ -321,7 +330,8 @@ def main(argv=None) -> int:
         apply_fn = functools.partial(cannet_apply, s2d_stem=True)
     remat_policy = make_remat_policy(args.remat,
                                      global_batch=args.batch_size * dp,
-                                     bf16=args.bf16, announce=main_proc)
+                                     bf16=args.bf16, announce=main_proc,
+                                     hbm_bytes=hbm, shards=ndev)
     if args.sp > 1:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
@@ -357,7 +367,7 @@ def main(argv=None) -> int:
                     import itertools
 
                     batches = itertools.islice(batches, args.max_steps_per_epoch)
-                state, mean_loss = train_one_epoch(
+                state, stats = train_one_epoch(
                     train_step, state, batches, put_fn=put, epoch=epoch,
                     show_progress=main_proc,
                     total=steps_per_epoch)
@@ -365,11 +375,11 @@ def main(argv=None) -> int:
                 # the shape count — a bucketing misconfiguration shows up
                 # here as distinct_shapes churning mid-run
                 epoch_metrics = {
-                    "train_loss": float(mean_loss),
+                    "train_loss": stats.loss,
                     "lr": float(schedule(int(state.step))),
-                    "img_per_s": round(mean_loss.img_per_s, 2),
-                    "epoch_s": round(mean_loss.seconds, 2),
-                    "distinct_shapes": mean_loss.distinct_shapes,
+                    "img_per_s": round(stats.img_per_s, 2),
+                    "epoch_s": round(stats.seconds, 2),
+                    "distinct_shapes": stats.distinct_shapes,
                 }
 
                 eval_epoch = (epoch + 1) % args.eval_interval == 0

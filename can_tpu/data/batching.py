@@ -481,24 +481,22 @@ class ShardedBatcher:
         schedules more pixels than padding to the full global batch.
 
         Deterministic; parts returned descending, so any fill slots land
-        in the final (smallest) part."""
-        memo = {}
+        in the final (smallest) part.
 
-        def f(r):
-            if r <= 0:
-                return (0.0, 0, ())
-            got = memo.get(r)
-            if got is None:
-                # ties on cost prefer fewer launches, then the
-                # lexicographically smallest part tuple (determinism)
-                got = memo[r] = min(
-                    (area * s + launch_cost + sub[0], 1 + sub[1],
-                     (s,) + sub[2])
-                    for s in menu
-                    for sub in (f(r - s),))
-            return got
-
-        return tuple(sorted(f(n)[2], reverse=True))
+        Bottom-up table over 0..n, not recursion: the memoized recursive
+        form went ~n/min(menu) frames deep, which blows Python's stack at
+        batch_quantum=1 once merged straggler counts span several large
+        global batches (ADVICE r4)."""
+        base = (0.0, 0, ())
+        best = [base] * (n + 1 if n > 0 else 1)
+        for r in range(1, n + 1):
+            # ties on cost prefer fewer launches, then the
+            # lexicographically smallest part tuple (determinism)
+            best[r] = min(
+                (area * s + launch_cost + sub[0], 1 + sub[1], (s,) + sub[2])
+                for s in menu
+                for sub in (best[r - s] if r > s else base,))
+        return tuple(sorted(best[n if n > 0 else 0][2], reverse=True))
 
     def _partial_plan(self):
         """Epoch-invariant remnant plan for ladder mode.

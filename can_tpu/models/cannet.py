@@ -33,6 +33,7 @@ from typing import Any, Callable, Mapping, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from can_tpu.ops.conv import conv1x1, conv2d
 from can_tpu.ops.pooling import adaptive_avg_pool2d, max_pool2d
@@ -202,13 +203,24 @@ def cannet_apply(
                                      axes=ops.bn_axes, n_shards=ops.bn_shards)
             if new_stats is not None:
                 new_stats[group].append(updated)
-        return jax.nn.relu(y)
+        # checkpoint_name: identity outside jax.checkpoint; under a named
+        # remat policy (save_anything_except_these_names) it lets the
+        # backward RECOMPUTE chosen activations instead of reading them
+        # from HBM — the selective-remat bandwidth probe
+        # (tools/ablate_mfu.py; train/steps.py remat_policy).  Both the
+        # pre-activation (the relu-vjp residual) and the relu output (the
+        # next conv's residual) are named, so excluding "{group}{i}*"
+        # really removes that layer's full activation from HBM.
+        y = checkpoint_name(y, f"{group}{i}.pre")
+        return checkpoint_name(jax.nn.relu(y), f"{group}{i}")
 
     # --- VGG-16 frontend ---
     i = 0
+    n_pool = 0
     for v in FRONTEND_CFG:
         if v == "M":
-            x = ops.max_pool(x)
+            n_pool += 1
+            x = checkpoint_name(ops.max_pool(x), f"pool{n_pool}")
         else:
             x = conv_block(x, "frontend", i, 1)
             i += 1

@@ -7,6 +7,7 @@ from .runtime import (
     is_main_process,
     barrier,
     reduce_value,
+    agree_min_value,
 )
 from .data_parallel import (
     make_global_batch,
@@ -25,6 +26,7 @@ __all__ = [
     "is_main_process",
     "barrier",
     "reduce_value",
+    "agree_min_value",
     "make_global_batch",
     "make_dp_train_step",
     "make_dp_eval_step",

@@ -139,3 +139,16 @@ def reduce_value(value, average: bool = True):
     gathered = multihost_utils.process_allgather(np.asarray(value))
     total = gathered.sum(axis=0)
     return total / jax.process_count() if average else total
+
+
+def agree_min_value(value):
+    """Minimum of a host-side scalar/array across processes (no-op at
+    world size 1).  For numbers every host must DERIVE IDENTICALLY from
+    per-host measurements — e.g. the HBM launch cap: the lockstep batch
+    schedule breaks if hosts disagree, and min is the conservative
+    agreement (no host schedules a launch another host can't fit)."""
+    if jax.process_count() < 2:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(np.asarray(value)).min(axis=0)

@@ -58,7 +58,8 @@ class NonFiniteLossError(RuntimeError):
 
 
 def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
-                    compute_dtype=None, remat: bool = False) -> Callable:
+                    compute_dtype=None, remat: bool = False,
+                    remat_policy=None) -> Callable:
     """Returns ``train_step(state, batch) -> (state, metrics)`` (un-jitted).
 
     batch: dict with image/dmap/pixel_mask/sample_mask (see data/batching.py).
@@ -66,6 +67,12 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
     remat: rematerialise the forward in backward (``jax.checkpoint``) —
     trades ~1/3 more FLOPs for not keeping every VGG activation in HBM,
     enabling much larger batches / resolutions per chip.
+    remat_policy: optional jax.checkpoint policy for SELECTIVE remat (only
+    meaningful with remat=True) — e.g.
+    ``save_anything_except_these_names("frontend0.pre", "frontend0", ...)``
+    recomputes just the named full-res activations (models/cannet.py
+    checkpoint_name tags) to trade a sliver of FLOPs for HBM bandwidth
+    (tools/ablate_mfu.py measures whether that moves the MFU plateau).
     """
 
     def train_step(state, batch):
@@ -80,7 +87,8 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
 
         fwd = fwd_bn if has_bn else fwd_plain
         if remat:
-            fwd = jax.checkpoint(fwd)
+            fwd = (jax.checkpoint(fwd, policy=remat_policy)
+                   if remat_policy is not None else jax.checkpoint(fwd))
 
         image = _batch_image(batch)
 

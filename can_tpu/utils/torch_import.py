@@ -1,0 +1,168 @@
+"""Import reference (torch) CANNet checkpoints into can_tpu params.
+
+The reference ecosystem's most valuable artifact is a TRAINED checkpoint
+(reference test.py:19,69 loads ``./checkpoints/epoch_354.pth`` — the
+published Part-A MAE 62.3 model).  This module maps that state dict onto
+the functional params tree, so the framework can reproduce the
+reference's quality claim directly from the reference's own weights — no
+500-epoch training run needed.
+
+Reference layout (model/CANNet.py:8-27, registration order):
+
+* ``frontend.{k}.weight/bias`` — ``make_layers([64,64,M,128,128,M,256,
+  256,256,M,512,512,512])`` = conv+ReLU per entry, MaxPool per 'M', so
+  the 10 convs sit at Sequential indices (0,2,5,7,10,12,14,17,19,21).
+* ``backend.{k}.weight/bias`` — ``make_layers([512,512,512,256,128,64],
+  in_channels=1024, dilation=True)`` = conv+ReLU pairs, convs at
+  (0,2,4,6,8,10).
+* ``output_layer.weight/bias`` — 1x1 conv, 64 -> 1.
+* ``conv{s}_{1,2}.weight`` for s in (1,2,3,6) — the biasless context
+  1x1 convs (model/CANNet.py:18-25); ``_1`` transforms the pooled
+  average (our ``context[s{s}].ave``), ``_2`` produces the contrast
+  weight (our ``.weight``).
+
+Checkpoints saved under DistributedDataParallel carry a ``module.``
+prefix (reference train.py:161 saves ``model.state_dict()`` of the DDP
+wrapper); both prefixed and bare dicts are accepted.
+
+Layout conversions: torch conv weights are OIHW, ours are HWIO
+(NHWC/lane-friendly); the biasless 1x1s become (Cin, Cout) matmul
+matrices (a 1x1 conv IS a channel matmul — models/cannet.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Tuple
+
+import numpy as np
+
+from can_tpu.models.cannet import BACKEND_CFG, CONTEXT_SCALES, FRONTEND_CFG, _FEAT_CH
+
+# Sequential indices of the conv layers inside each make_layers stack.
+FRONTEND_SEQ_IDX: Tuple[int, ...] = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21)
+BACKEND_SEQ_IDX: Tuple[int, ...] = (0, 2, 4, 6, 8, 10)
+
+
+def reference_param_shapes() -> Dict[str, Tuple[int, ...]]:
+    """Expected (bare) reference state-dict keys -> torch shapes (OIHW)."""
+    spec: Dict[str, Tuple[int, ...]] = {}
+    cin = 3
+    chans = [v for v in FRONTEND_CFG if v != "M"]
+    for k, cout in zip(FRONTEND_SEQ_IDX, chans):
+        spec[f"frontend.{k}.weight"] = (cout, cin, 3, 3)
+        spec[f"frontend.{k}.bias"] = (cout,)
+        cin = cout
+    cin = 2 * _FEAT_CH
+    for k, cout in zip(BACKEND_SEQ_IDX, BACKEND_CFG):
+        spec[f"backend.{k}.weight"] = (cout, cin, 3, 3)
+        spec[f"backend.{k}.bias"] = (cout,)
+        cin = cout
+    spec["output_layer.weight"] = (1, BACKEND_CFG[-1], 1, 1)
+    spec["output_layer.bias"] = (1,)
+    for s in CONTEXT_SCALES:
+        for j in (1, 2):
+            spec[f"conv{s}_{j}.weight"] = (_FEAT_CH, _FEAT_CH, 1, 1)
+    return spec
+
+
+def _strip_prefix(sd: Mapping) -> Dict[str, np.ndarray]:
+    """Drop the DDP ``module.`` prefix if every key carries it."""
+    keys = list(sd)
+    if keys and all(k.startswith("module.") for k in keys):
+        return {k[len("module."):]: v for k, v in sd.items()}
+    return dict(sd)
+
+
+def convert_state_dict(sd: Mapping) -> dict:
+    """Reference state dict (torch tensors or numpy) -> can_tpu params.
+
+    Strict: the key set and every shape must match the reference CANNet
+    exactly (missing/unexpected keys or a shape mismatch raise ValueError
+    naming the offenders) — a silently-partial import would reproduce
+    nothing (the reference's own ``strict=False`` resume bug, SURVEY §5).
+    """
+    sd = _strip_prefix(sd)
+    arrays = {k: np.asarray(getattr(v, "numpy", lambda: v)(), dtype=np.float32)
+              for k, v in sd.items()}
+    spec = reference_param_shapes()
+    missing = sorted(set(spec) - set(arrays))
+    unexpected = sorted(set(arrays) - set(spec))
+    if missing or unexpected:
+        raise ValueError(
+            "state dict does not match the reference CANNet layout: "
+            f"missing={missing[:6]}{'...' if len(missing) > 6 else ''} "
+            f"unexpected={unexpected[:6]}{'...' if len(unexpected) > 6 else ''}")
+    for k, shape in spec.items():
+        if tuple(arrays[k].shape) != shape:
+            raise ValueError(f"{k}: shape {tuple(arrays[k].shape)}, "
+                             f"want {shape}")
+
+    def hwio(w):  # torch OIHW -> our HWIO
+        return np.transpose(w, (2, 3, 1, 0))
+
+    params: dict = {"frontend": [], "context": {}, "backend": [], "output": None}
+    for k in FRONTEND_SEQ_IDX:
+        params["frontend"].append({"w": hwio(arrays[f"frontend.{k}.weight"]),
+                                   "b": arrays[f"frontend.{k}.bias"]})
+    for s in CONTEXT_SCALES:
+        # (O, I, 1, 1) -> (I, O): y = x @ M must equal y_o = sum_i w_oi x_i
+        params["context"][f"s{s}"] = {
+            "ave": arrays[f"conv{s}_1.weight"][:, :, 0, 0].T.copy(),
+            "weight": arrays[f"conv{s}_2.weight"][:, :, 0, 0].T.copy(),
+        }
+    for k in BACKEND_SEQ_IDX:
+        params["backend"].append({"w": hwio(arrays[f"backend.{k}.weight"]),
+                                  "b": arrays[f"backend.{k}.bias"]})
+    params["output"] = {"w": hwio(arrays["output_layer.weight"]),
+                        "b": arrays["output_layer.bias"]}
+    return params
+
+
+def load_torch_checkpoint(path: str) -> dict:
+    """``torch.load`` a reference checkpoint file -> can_tpu params.
+
+    Accepts the raw state dict (reference train.py:161) or common
+    wrappers ({'state_dict': ...} / {'model': ...}).
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    for wrap in ("state_dict", "model"):
+        if isinstance(obj, dict) and wrap in obj and isinstance(obj[wrap], dict):
+            obj = obj[wrap]
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    return convert_state_dict(obj)
+
+
+def save_params_npz(params: dict, path: str) -> None:
+    """Flatten the params tree to a torch-free ``.npz`` (keys like
+    ``frontend.0.w`` / ``context.s1.ave`` / ``output.b``)."""
+    flat = {}
+    for i, p in enumerate(params["frontend"]):
+        flat[f"frontend.{i}.w"], flat[f"frontend.{i}.b"] = p["w"], p["b"]
+    for s in CONTEXT_SCALES:
+        cp = params["context"][f"s{s}"]
+        flat[f"context.s{s}.ave"] = cp["ave"]
+        flat[f"context.s{s}.weight"] = cp["weight"]
+    for i, p in enumerate(params["backend"]):
+        flat[f"backend.{i}.w"], flat[f"backend.{i}.b"] = p["w"], p["b"]
+    flat["output.w"], flat["output.b"] = params["output"]["w"], params["output"]["b"]
+    np.savez(path, **flat)
+
+
+def load_params_npz(path: str) -> dict:
+    """Load a ``save_params_npz`` file back into a params tree."""
+    z = np.load(path)
+    params: dict = {"frontend": [], "context": {}, "backend": [], "output": None}
+    for i in range(len(FRONTEND_SEQ_IDX)):
+        params["frontend"].append({"w": z[f"frontend.{i}.w"],
+                                   "b": z[f"frontend.{i}.b"]})
+    for s in CONTEXT_SCALES:
+        params["context"][f"s{s}"] = {"ave": z[f"context.s{s}.ave"],
+                                      "weight": z[f"context.s{s}.weight"]}
+    for i in range(len(BACKEND_SEQ_IDX)):
+        params["backend"].append({"w": z[f"backend.{i}.w"],
+                                  "b": z[f"backend.{i}.b"]})
+    params["output"] = {"w": z["output.w"], "b": z["output.b"]}
+    return params

@@ -112,7 +112,7 @@ def main():
         eval_step = make_dp_eval_step(cannet_apply, mesh)
         put = lambda b: make_global_batch(b, mesh)
         eval_bs = 4
-    state, mean_loss = train_one_epoch(step, state, batcher.epoch(0),
+    state, train_stats = train_one_epoch(step, state, batcher.epoch(0),
                                        put_fn=put, show_progress=False)
 
     # evaluate() across REAL process boundaries: the lockstep eval schedule,
@@ -136,7 +136,7 @@ def main():
     assert abs(mean - total / nprocs) < 1e-6, mean
 
     with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
-        f.write(f"{mean_loss:.10g}\n")
+        f.write(f"{train_stats.loss:.10g}\n")
     with open(os.path.join(out_dir, f"mae_{rank}.txt"), "w") as f:
         f.write(f"{metrics['mae']:.10g} {metrics['mse']:.10g}\n")
     shutdown_runtime()

@@ -297,6 +297,45 @@ class TestRematPolicy:
         # straggler at batch 2 fits without remat
         assert not auto((1016, 1024), batch=2)
 
+    def test_per_device_scaling_with_shards(self):
+        # ADVICE r4 (medium): the footprint is per-DEVICE — a launch
+        # sharded over dp*sp devices puts 1/shards of its pixels on each.
+        # The global-pixel cap must scale by shards, and the remat policy
+        # must divide its estimate by shards, or dp>1 meshes cap launches
+        # dp x too small and over-remat.
+        from can_tpu.cli.common import make_remat_policy, max_launch_pixels
+
+        cap1 = max_launch_pixels(bf16=True, hbm_bytes=self.V5E_HBM)
+        cap4 = max_launch_pixels(bf16=True, hbm_bytes=self.V5E_HBM,
+                                 shards=4)
+        assert cap4 == 4 * cap1
+        # b64 x 1016x1024 on a dp=4 pod = the known per-device fit (b16
+        # OOMs single-chip, b8 fits; 64/4 = 16 per device is the OOM, so
+        # use b32 -> 8 per device: fits)
+        assert 32 * 1016 * 1024 <= cap4
+        assert 64 * 1016 * 1024 > cap4
+        auto1 = make_remat_policy("auto", global_batch=16, bf16=True,
+                                  hbm_bytes=self.V5E_HBM)
+        auto4 = make_remat_policy("auto", global_batch=64, bf16=True,
+                                  hbm_bytes=self.V5E_HBM, shards=4)
+        # same per-device work as the single-chip remat trigger: global
+        # b64 over 4 devices = b16 per device -> still remats ...
+        assert auto1((1016, 1024)) and auto4((1016, 1024))
+        # ... but global b16 over 4 devices = b4 per device -> must NOT
+        # (the old global-vs-one-device compare over-triggered here)
+        auto4b = make_remat_policy("auto", global_batch=16, bf16=True,
+                                   hbm_bytes=self.V5E_HBM, shards=4)
+        assert not auto4b((1016, 1024))
+
+    def test_agreed_hbm_single_process(self):
+        # ws=1 path: agreement is a no-op and must equal local detection
+        from can_tpu.cli.common import (
+            agreed_device_memory_bytes,
+            device_memory_bytes,
+        )
+
+        assert agreed_device_memory_bytes() == device_memory_bytes()
+
     def test_flag_parsing(self):
         from can_tpu.cli.train import parse_args
 

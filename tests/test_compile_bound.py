@@ -48,7 +48,7 @@ def test_200_wild_resolutions_compile_at_most_8_programs(tmp_path):
         step, state, batcher.epoch(0),
         put_fn=lambda b: make_global_batch(b, mesh), show_progress=False)
 
-    assert np.isfinite(float(stats))
+    assert np.isfinite(stats.loss)
     assert stats.images == 200
     assert stats.distinct_shapes <= 8  # == compile count of the train step
     assert batcher.padding_overhead() < 0.5

@@ -664,6 +664,22 @@ class TestRemnantSubBatches:
         # and never anything worse than the full-batch cover
         assert d(13, (16, 8, 4, 2, 1), launch_cost=1e12) == (16,)
 
+    def test_decompose_deep_no_recursion_limit(self):
+        # ADVICE r4: the old memoized-recursive DP went ~n/min(menu)
+        # frames deep — quantum 1 with a straggler count spanning several
+        # large global batches blew Python's 1000-frame default.  The
+        # bottom-up table must handle it and stay optimal.
+        import sys
+
+        n = 3 * sys.getrecursionlimit()  # would have required ~3000 frames
+        parts = ShardedBatcher._decompose(n, (64, 32, 16, 8, 4, 2, 1))
+        assert sum(parts) == n           # exact split, zero fill
+        assert parts[0] == 64            # descending, greedy-exact here
+        # priced case still collapses to a single cover part
+        big = ShardedBatcher._decompose(n - 1, (4096, 64, 1),
+                                        launch_cost=1e12)
+        assert big == (4096,)
+
     def test_launch_cost_prefers_fewer_batches(self):
         # the measured reality behind the knob (tools/diag_remnant.py r4):
         # a step launch costs ~50 ms on the dev tunnel, so the pixel

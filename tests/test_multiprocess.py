@@ -137,7 +137,7 @@ def _single_process_reference(tmp_path, mode: str):
     eval_batcher = ShardedBatcher(eval_ds, eval_bs, shuffle=False)
     metrics = evaluate(eval_step, state.params, eval_batcher.epoch(0),
                        put_fn=put, dataset_size=eval_batcher.dataset_size)
-    return float(want), (metrics["mae"], metrics["mse"])
+    return want.loss, (metrics["mae"], metrics["mse"])
 
 
 def test_two_process_training_agrees(tmp_path):

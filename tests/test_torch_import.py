@@ -1,0 +1,183 @@
+"""Round-trip: reference-layout torch checkpoint -> can_tpu params.
+
+Builds a torch nn.Module with EXACTLY the reference CANNet's state-dict
+layout (module/attribute registration order, Sequential indices, shapes —
+written fresh from the spec at reference model/CANNet.py:8-27), saves its
+state dict the way the reference does (train.py:161), imports it through
+can_tpu.utils.torch_import, and checks the torch forward equals the
+can_tpu forward on a real-shaped image to f32 tolerance (VERDICT r4
+missing-2: this is what makes the published Part-A checkpoint usable).
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax.numpy as jnp
+
+from can_tpu.models import cannet_apply
+from can_tpu.utils.torch_import import (
+    convert_state_dict,
+    load_params_npz,
+    load_torch_checkpoint,
+    reference_param_shapes,
+    save_params_npz,
+)
+from tests.test_model import torch_cannet_forward
+
+
+def _layers(cfg, in_ch, dilation=1):
+    seq = []
+    for v in cfg:
+        if v == "M":
+            seq.append(nn.MaxPool2d(2, 2))
+        else:
+            seq += [nn.Conv2d(in_ch, v, 3, padding=dilation,
+                              dilation=dilation), nn.ReLU(inplace=True)]
+            in_ch = v
+    return nn.Sequential(*seq)
+
+
+class RefLayoutCANNet(nn.Module):
+    """State-dict-layout mirror of reference model/CANNet.py:8-27
+    (attribute registration order matters: it fixes the tensor ordinal
+    positions the reference's VGG copy loop relies on)."""
+
+    def __init__(self):
+        super().__init__()
+        self.frontend = _layers(
+            [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512], 3)
+        self.backend = _layers([512, 512, 512, 256, 128, 64], 1024, dilation=2)
+        self.output_layer = nn.Conv2d(64, 1, 1)
+        for s in (1, 2, 3, 6):
+            for j in (1, 2):
+                setattr(self, f"conv{s}_{j}", nn.Conv2d(512, 512, 1, bias=False))
+
+
+@pytest.fixture(scope="module")
+def ref_model():
+    torch.manual_seed(7)
+    m = RefLayoutCANNet()
+    # N(0, 0.01) like the reference init so activations are in-range
+    with torch.no_grad():
+        for p in m.parameters():
+            if p.ndim == 4:
+                p.normal_(0.0, 0.01)
+            else:
+                p.zero_()
+    return m
+
+
+def test_layout_spec_matches_torch_module(ref_model):
+    sd = ref_model.state_dict()
+    spec = reference_param_shapes()
+    # ORDER matters (the reference's VGG copy is ordinal): exact list match
+    assert list(sd) == list(spec)
+    for k, v in sd.items():
+        assert tuple(v.shape) == spec[k], k
+
+
+def test_roundtrip_forward_parity(tmp_path, ref_model):
+    path = str(tmp_path / "epoch_354.pth")
+    torch.save(ref_model.state_dict(), path)  # reference train.py:161 form
+    params = load_torch_checkpoint(path)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 128, 96, 3)).astype(np.float32)
+    ours = np.asarray(cannet_apply(params, jnp.asarray(x), precision="highest"))
+
+    # oracle 1: the independent functional mirror fed the imported params
+    with torch.no_grad():
+        mirror = (torch_cannet_forward(params,
+                                       torch.from_numpy(x).permute(0, 3, 1, 2))
+                  .permute(0, 2, 3, 1).numpy())
+    np.testing.assert_allclose(ours, mirror, rtol=1e-3, atol=1e-5)
+
+    # oracle 2: importing must be exact — the converted tensors ARE the
+    # torch tensors, relaid out
+    sd = ref_model.state_dict()
+    w0 = sd["frontend.0.weight"].numpy()
+    np.testing.assert_array_equal(params["frontend"][0]["w"],
+                                  np.transpose(w0, (2, 3, 1, 0)))
+    c1 = sd["conv1_1.weight"].numpy()[:, :, 0, 0]
+    np.testing.assert_array_equal(params["context"]["s1"]["ave"], c1.T)
+
+
+def test_ddp_prefix_accepted(ref_model):
+    sd = {f"module.{k}": v for k, v in ref_model.state_dict().items()}
+    params = convert_state_dict(sd)
+    assert len(params["frontend"]) == 10
+
+
+def test_strict_validation():
+    spec = reference_param_shapes()
+    full = {k: np.zeros(s, np.float32) for k, s in spec.items()}
+    missing = dict(full)
+    del missing["backend.4.weight"]
+    with pytest.raises(ValueError, match="backend.4.weight"):
+        convert_state_dict(missing)
+    extra = dict(full, **{"frontend.24.weight": np.zeros((1,), np.float32)})
+    with pytest.raises(ValueError, match="frontend.24.weight"):
+        convert_state_dict(extra)
+    bad = dict(full)
+    bad["output_layer.weight"] = np.zeros((1, 64, 3, 3), np.float32)
+    with pytest.raises(ValueError, match="output_layer.weight"):
+        convert_state_dict(bad)
+
+
+def test_vgg16_manifest_pins_layout():
+    """tools/convert_vgg16.py validates .pth layout against the committed
+    manifest (VERDICT r4 missing-5): matching dicts pass, drifted key
+    order / shapes fail loudly."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from make_vgg16_manifest import build_plain_torch_vgg16, manifest_entries
+
+        from tools.convert_vgg16 import (
+            state_dict_to_npz_arrays,
+            validate_against_manifest,
+        )
+
+        import json
+
+        committed = json.load(open("tools/vgg16_manifest.json"))["entries"]
+        derived = manifest_entries(build_plain_torch_vgg16())
+        assert committed == derived  # fixture in sync with the derivation
+
+        good = build_plain_torch_vgg16().state_dict()
+        arrays = state_dict_to_npz_arrays(good)  # validates internally
+        assert arrays["conv0_w"].shape == (3, 3, 3, 64)  # HWIO
+
+        # key-order drift: the ordinal copy would grab wrong tensors
+        items = list(good.items())
+        swapped = dict([items[2], items[3]] + items[:2] + items[4:])
+        with pytest.raises(ValueError, match="first 20 tensors"):
+            validate_against_manifest(swapped)
+
+        # shape drift (e.g. a BN variant or truncated file)
+        bad = dict(good)
+        bad["features.0.weight"] = torch.zeros((64, 3, 7, 7))
+        with pytest.raises(ValueError, match="first 20 tensors"):
+            validate_against_manifest(bad)
+
+        # truncated dict whose present entries match: the error must
+        # still NAME the absent positions (zip_longest, review r5)
+        trunc = dict(list(good.items())[:18])
+        with pytest.raises(ValueError, match="<absent>"):
+            validate_against_manifest(trunc)
+    finally:
+        sys.path.remove("tools")
+
+
+def test_npz_roundtrip(tmp_path, ref_model):
+    params = convert_state_dict(ref_model.state_dict())
+    path = str(tmp_path / "can_params.npz")
+    save_params_npz(params, path)
+    again = load_params_npz(path)
+    x = np.ones((1, 64, 64, 3), np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(cannet_apply(params, jnp.asarray(x))),
+        np.asarray(cannet_apply(again, jnp.asarray(x))))

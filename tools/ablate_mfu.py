@@ -1,0 +1,119 @@
+"""MFU-plateau probe: selective remat of full-res activations (VERDICT r4
+weak-6 — "one more idea with a plausible mechanism, then close the axis").
+
+The r4 profile shows the headline step fusion-saturated at ~60% of v5e
+bf16 peak; the residual is HBM traffic, dominated by save-for-backward
+activations — the largest of which are the full-resolution stem tensors
+(bf16[B,H,W,64], 2x lane-padded; same tensors that dominate the OOM dump,
+cli/common.py activation_bytes).  Mechanism under test: recompute exactly
+those tensors in the backward instead of reading them back, via
+``jax.checkpoint`` + ``save_anything_except_these_names`` over the
+``checkpoint_name`` tags in models/cannet.py.  The recompute cost is tiny
+(stem convs are <1% of step FLOPs) while the saved reads are the largest
+single activations — if bandwidth is the binding constraint this HELPS;
+if the gain is zero the plateau is not activation-read-bound and the
+axis closes with that number.
+
+Variants (cumulative exclusion, finest first):
+  baseline    — no remat (the shipped headline config)
+  stem        — recompute frontend convs 0-1 (full res, 64ch)
+  half        — + convs 2-3 (1/2 res, 128ch)
+  quarter     — + convs 4-6 (1/4 res, 256ch)
+  full_remat  — jax.checkpoint of the whole forward (the r2 ablation)
+
+Run on the chip: ``python tools/ablate_mfu.py`` (~2 min; one compile per
+variant).  CPU smoke: ``ABLATE_PLATFORM=cpu ABLATE_STEPS=2 ABLATE_BATCH=2
+ABLATE_H=64 ABLATE_W=64 python tools/ablate_mfu.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEM_NAMES = ("frontend0.pre", "frontend0", "frontend1.pre", "frontend1")
+HALF_NAMES = STEM_NAMES + ("frontend2.pre", "frontend2",
+                           "frontend3.pre", "frontend3")
+QUARTER_NAMES = HALF_NAMES + ("frontend4.pre", "frontend4",
+                              "frontend5.pre", "frontend5",
+                              "frontend6.pre", "frontend6")
+
+
+def main() -> None:
+    if os.environ.get("ABLATE_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from can_tpu.utils import await_devices
+
+    await_devices()
+    import jax
+    import jax.numpy as jnp
+
+    from can_tpu.data.batching import Batch
+    from can_tpu.models import cannet_apply, cannet_init
+    from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+    from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+    from can_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    b = int(os.environ.get("ABLATE_BATCH", "16"))
+    h = int(os.environ.get("ABLATE_H", "576"))
+    w = int(os.environ.get("ABLATE_W", "768"))
+    steps = int(os.environ.get("ABLATE_STEPS", "20"))
+    ndev = jax.device_count()
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+    local_b = b * ndev
+    batch = Batch(
+        image=rng.normal(size=(local_b, h, w, 3)).astype(np.float32),
+        dmap=rng.uniform(size=(local_b, h // 8, w // 8, 1)).astype(np.float32),
+        pixel_mask=np.ones((local_b, h // 8, w // 8, 1), np.float32),
+        sample_mask=np.ones((local_b,), np.float32),
+    )
+    gbatch = make_global_batch(batch, mesh)
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
+
+    except_names = jax.checkpoint_policies.save_anything_except_these_names
+    variants = {
+        "baseline": dict(remat=False),
+        "stem": dict(remat=True, remat_policy=except_names(*STEM_NAMES)),
+        "half": dict(remat=True, remat_policy=except_names(*HALF_NAMES)),
+        "quarter": dict(remat=True, remat_policy=except_names(*QUARTER_NAMES)),
+        "full_remat": dict(remat=True),
+    }
+
+    results = {}
+    losses = {}
+    for name, kw in variants.items():
+        state = create_train_state(cannet_init(jax.random.key(0)), opt)
+        step = make_dp_train_step(cannet_apply, opt, mesh,
+                                  compute_dtype=jnp.bfloat16, **kw)
+        for _ in range(3):
+            state, metrics = step(state, gbatch)
+        float(jax.device_get(metrics["loss"]))  # fence (tunnel-safe)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, gbatch)
+        losses[name] = float(jax.device_get(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        results[name] = round(local_b * steps / dt, 2)
+        print(f"[ablate_mfu] {name:10s}: {results[name]:8.2f} img/s")
+
+    # remat changes memory/bandwidth, never math: same-trajectory check
+    base = losses["baseline"]
+    for name, loss in losses.items():
+        assert np.isfinite(loss) and abs(loss - base) / abs(base) < 5e-2, (
+            name, loss, base)
+    print(json.dumps({"config": f"{h}x{w} b{b} bf16 x{steps}steps",
+                      "img_per_s": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,8 @@ def main() -> int:
         from can_tpu.cli.common import max_launch_pixels
 
         cap = max_launch_pixels(bf16=args.bf16,
-                                hbm_bytes=int(args.hbm_gib * 1024 ** 3))
+                                hbm_bytes=int(args.hbm_gib * 1024 ** 3),
+                                shards=args.dp)
     b = ShardedBatcher(ds, args.batch_size * args.dp // args.hosts,
                        shuffle=not args.eval, seed=0,
                        process_count=args.hosts,

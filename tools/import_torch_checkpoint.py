@@ -1,0 +1,44 @@
+"""Convert a reference (torch) CANNet checkpoint to can_tpu params.
+
+The reference's published quality number (Part-A MAE 62.3, reference
+README.md:37) lives in a trained ``epoch_354.pth`` (test.py:19,69).  This
+tool converts such a checkpoint — DDP ``module.``-prefixed or bare — into
+a torch-free ``.npz`` params file, and the eval CLI consumes either form
+directly via ``--torch-pth`` / ``--params-npz``:
+
+    python tools/import_torch_checkpoint.py --pth epoch_354.pth --out can_params.npz
+    can-tpu-test --data_root .../part_A --params-npz can_params.npz
+    can-tpu-test --data_root .../part_A --torch-pth epoch_354.pth   # one step
+
+Mapping + validation live in can_tpu/utils/torch_import.py (strict: any
+layout drift fails loudly, naming the offending keys).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pth", required=True,
+                    help="reference checkpoint (torch state dict)")
+    ap.add_argument("--out", default="can_params.npz")
+    args = ap.parse_args()
+
+    from can_tpu.utils.torch_import import load_torch_checkpoint, save_params_npz
+
+    params = load_torch_checkpoint(args.pth)
+    save_params_npz(params, args.out)
+    n = sum(int(v.size) for layer in params["frontend"] + params["backend"]
+            for v in layer.values())
+    print(f"wrote {args.out} (frontend+backend {n:,} params, "
+          f"+ context 1x1s and output head)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Generate (or verify) the torchvision VGG-16 state-dict layout manifest.
+
+``tools/convert_vgg16.py`` assumes torchvision ``vgg16``'s state-dict key
+ORDER when mapping "the first 20 tensors" onto the frontend the way the
+reference does by ordinal position (reference model/CANNet.py:30-35).
+That assumption must fail LOUDLY if a given ``.pth`` has a different
+layout (VERDICT r4 missing-3).  The committed fixture
+``tools/vgg16_manifest.json`` pins the expected layout: an ordered list
+of (key, shape, dtype).
+
+This environment has no egress and no torchvision, so the manifest is
+derived from the architecture itself: VGG-16 ("configuration D",
+Simonyan & Zisserman 2014) as torchvision builds it — ``features`` =
+convs/ReLUs/MaxPools from cfg [64,64,M,128,128,M,256,256,256,M,512,512,
+512,M,512,512,512,M] (each conv 3x3 pad 1), ``avgpool``, ``classifier``
+= Linear(25088,4096), ReLU, Dropout, Linear(4096,4096), ReLU, Dropout,
+Linear(4096,1000).  State-dict key names and order follow module
+registration, reproduced here with a plain-torch module using the same
+attribute names.  If a real torchvision is present, the script instead
+cross-checks the derivation against it.
+
+Usage: python tools/make_vgg16_manifest.py [--out tools/vgg16_manifest.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+VGG16_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+             512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def build_plain_torch_vgg16():
+    """torchvision-layout vgg16 rebuilt from the architecture (no weights)."""
+    import torch.nn as nn
+
+    layers = []
+    in_ch = 3
+    for v in VGG16_CFG:
+        if v == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(in_ch, v, 3, padding=1), nn.ReLU(True)]
+            in_ch = v
+
+    class VGG(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = nn.Sequential(*layers)
+            self.avgpool = nn.AdaptiveAvgPool2d((7, 7))
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(True), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(True), nn.Dropout(),
+                nn.Linear(4096, 1000))
+
+    return VGG()
+
+
+def manifest_entries(model) -> list:
+    return [{"key": k, "shape": list(v.shape), "dtype": str(v.dtype).replace("torch.", "")}
+            for k, v in model.state_dict().items()]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(os.path.dirname(__file__),
+                                                  "vgg16_manifest.json"))
+    args = ap.parse_args()
+
+    entries = manifest_entries(build_plain_torch_vgg16())
+    try:  # cross-check against real torchvision when available
+        from torchvision import models
+
+        real = manifest_entries(models.vgg16(weights=None))
+        assert entries == real, "architecture-derived manifest != torchvision"
+        source = "torchvision (verified against architecture derivation)"
+    except ImportError:
+        source = "architecture derivation (torchvision not installed)"
+
+    with open(args.out, "w") as f:
+        json.dump({"model": "torchvision vgg16 (cfg D, no BN)",
+                   "source": source, "entries": entries}, f, indent=1)
+    print(f"wrote {args.out}: {len(entries)} tensors ({source})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 #!/bin/bash
-# The round-4 chip queue — everything that was blocked when the dev
-# tunnel died mid-round (CHANGES.md round-4 environment note).  Run on a
-# host with ONE live TPU attached (single process at a time!):
+# The chip queue — every measurement blocked on a live TPU (the round-4
+# tunnel died mid-round and stayed dead through round 5; CHANGES.md).
+# Run on a host with ONE live TPU attached (single process at a time!):
 #
 #   bash tools/run_chip_queue.sh [out_dir]
 #
@@ -9,7 +9,14 @@
 #  1. convergence golden, twice (drift check) -> paste the --record
 #     trajectory into tools/bench_convergence.py GOLDEN_TPU_MAES, commit;
 #  2. full-scale Part-A rehearsal (reference lr 1e-7 at full shapes);
-#  3. the varres re-measure + full bench sweep -> BENCH_SUITE_r{N}.json.
+#  3. the bench sweep -> BENCH_SUITE_r{N}.json: varres re-measure,
+#     the QUOTED u8 varres end-to-end entry
+#     (train_pipeline_varres_b8_bf16_u8_end_to_end), and the
+#     eval_pipeline_varres prefetch-off/on A/B (r5 eval prefetch);
+#  4. launch-cost probe vs real-step dispatch (tunnel row of the
+#     CHANGES.md r5 calibration table; the CPU row is committed);
+#  5. the selective-remat MFU ablation (r5: the last plateau idea —
+#     paste into CHANGES.md and either claim the win or close the axis).
 # Each step fails fast on a dead backend (utils.await_devices).
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -28,7 +35,13 @@ echo "== 2. full-scale Part-A rehearsal (full shapes, reference lr)"
 python tools/rehearse_part_a.py --root "$OUT/rehearsal" --epochs 3 \
     --scale 1.0 --lr 1e-7 | tee "$OUT/rehearsal.txt"
 
-echo "== 3. bench sweep (varres re-measure incl. b16 remat-auto cap)"
+echo "== 3. bench sweep (varres + u8 end-to-end + eval prefetch A/B)"
 python bench_suite.py | tee "$OUT/bench_suite.txt"
+
+echo "== 4. launch-cost probe vs real step dispatch (tunnel row)"
+python tools/launch_cost_probe.py | tee "$OUT/launch_cost.txt"
+
+echo "== 5. selective-remat MFU ablation"
+python tools/ablate_mfu.py | tee "$OUT/ablate_mfu.txt"
 
 echo "== queue done; artifacts in $OUT"
