@@ -97,6 +97,13 @@ def parse_args(argv=None):
     p.add_argument("--test-gt-root", type=str, default="")
     p.add_argument("--init_checkpoint", type=str, default="",
                    help="checkpoint dir to resume from (latest epoch)")
+    p.add_argument("--init-torch-pth", type=str, default="",
+                   help="warm-start params from a REFERENCE torch "
+                        "checkpoint (e.g. the published epoch_354.pth) — "
+                        "the reference's --init_checkpoint .pth workflow "
+                        "(its train.py:98-102,113), but with STRICT layout "
+                        "validation instead of strict=False; params only "
+                        "(optimizer/step start fresh)")
     # TPU-native knobs
     p.add_argument("--checkpoint-dir", type=str, default="./checkpoints")
     p.add_argument("--seed", type=int, default=0)
@@ -211,6 +218,20 @@ def main(argv=None) -> int:
         "train", args.train_image_root, args.train_gt_root, args.data_root)
     test_img, test_gt = resolve_split_roots(
         "test", args.test_image_root, args.test_gt_root, args.data_root)
+    if args.init_torch_pth:
+        if args.syncBN:
+            raise SystemExit("--init-torch-pth holds the reference model "
+                             "(no BatchNorm); drop --syncBN")
+        if args.vgg16_npz:
+            raise SystemExit("--init-torch-pth already contains the trained "
+                             "frontend; drop --vgg16-npz")
+        if args.init_checkpoint:
+            raise SystemExit("--init-torch-pth (fresh warm-start) and "
+                             "--init_checkpoint (full-state resume) "
+                             "conflict — the resume would silently replace "
+                             "the warm-started params; pick one")
+        if not os.path.isfile(args.init_torch_pth):
+            raise SystemExit(f"no such checkpoint file: {args.init_torch_pth}")
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
@@ -292,6 +313,17 @@ def main(argv=None) -> int:
         params = load_vgg16_frontend(params, args.vgg16_npz)
         if main_proc:
             print(f"[init] loaded pretrained VGG-16 frontend from {args.vgg16_npz}")
+    if args.init_torch_pth:
+        # the reference's .pth warm-start (its train.py:98-102 resumes
+        # model-only with strict=False; here the layout check is strict) —
+        # params from the torch checkpoint, optimizer/step fresh.
+        # Deterministic file read on every host => identical init holds.
+        from can_tpu.utils.torch_import import load_torch_checkpoint
+
+        params = load_torch_checkpoint(args.init_torch_pth)
+        if main_proc:
+            print(f"[init] warm-started params from reference checkpoint "
+                  f"{args.init_torch_pth}")
 
     # the epoch-0 count is exact for EVERY epoch: an item's bucket cell is a
     # pure function of its shape, so per-cell counts — hence full batches,

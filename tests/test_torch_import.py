@@ -172,6 +172,32 @@ def test_vgg16_manifest_pins_layout():
         sys.path.remove("tools")
 
 
+def test_train_cli_warm_start_flag_validation(tmp_path):
+    """--init-torch-pth conflicts exit at parse/path-validation time,
+    BEFORE any runtime init (the train CLI's pre-rendezvous contract)."""
+    from can_tpu.data import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path / "train_data"), 2,
+                           sizes=((64, 64),), seed=0)
+    make_synthetic_dataset(str(tmp_path / "test_data"), 2,
+                           sizes=((64, 64),), seed=1)
+    pth = tmp_path / "ckpt.pth"
+    pth.write_bytes(b"not-read-during-validation")
+
+    from can_tpu.cli.train import main
+
+    base = ["--data_root", str(tmp_path), "--init-torch-pth", str(pth)]
+    with pytest.raises(SystemExit, match="syncBN"):
+        main(base + ["--syncBN"])
+    with pytest.raises(SystemExit, match="vgg16"):
+        main(base + ["--vgg16-npz", "whatever.npz"])
+    with pytest.raises(SystemExit, match="init_checkpoint"):
+        main(base + ["--init_checkpoint", str(tmp_path)])
+    with pytest.raises(SystemExit, match="no such checkpoint"):
+        main(["--data_root", str(tmp_path),
+              "--init-torch-pth", str(tmp_path / "missing.pth")])
+
+
 def test_npz_roundtrip(tmp_path, ref_model):
     params = convert_state_dict(ref_model.state_dict())
     path = str(tmp_path / "can_params.npz")
