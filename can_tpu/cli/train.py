@@ -95,8 +95,11 @@ def parse_args(argv=None):
     p.add_argument("--train-gt-root", type=str, default="")
     p.add_argument("--test-image-root", type=str, default="")
     p.add_argument("--test-gt-root", type=str, default="")
-    p.add_argument("--init_checkpoint", type=str, default="",
-                   help="checkpoint dir to resume from (latest epoch)")
+    p.add_argument("--init_checkpoint", "--init-checkpoint", type=str,
+                   default="",
+                   help="checkpoint dir to resume from (latest epoch); "
+                        "underscore spelling is the reference's, dashed "
+                        "alias matches this CLI's convention")
     p.add_argument("--init-torch-pth", type=str, default="",
                    help="warm-start params from a REFERENCE torch "
                         "checkpoint (e.g. the published epoch_354.pth) — "

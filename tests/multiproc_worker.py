@@ -134,6 +134,17 @@ def main():
     assert total == sum(r + 1 for r in range(nprocs)), total
     mean = float(reduce_value(np.float32(rank + 1), average=True))
     assert abs(mean - total / nprocs) < 1e-6, mean
+    # r5: min-agreement across processes — the HBM-cap path.  Ranks feed
+    # different values; every rank must get the min, and the full
+    # agreed_device_memory_bytes flow must agree (None==None on CPU).
+    from can_tpu.parallel import agree_min_value
+
+    lo = float(agree_min_value(np.float64(100.0 + rank)))
+    assert lo == 100.0, lo
+    from can_tpu.cli.common import agreed_device_memory_bytes
+
+    hbm = agreed_device_memory_bytes()
+    assert hbm is None or hbm > 0, hbm
 
     with open(os.path.join(out_dir, f"loss_{rank}.txt"), "w") as f:
         f.write(f"{train_stats.loss:.10g}\n")

@@ -198,6 +198,49 @@ def test_train_cli_warm_start_flag_validation(tmp_path):
               "--init-torch-pth", str(tmp_path / "missing.pth")])
 
 
+def test_train_cli_warm_start_happy_path(tmp_path, ref_model):
+    """A train run with --init-torch-pth really starts FROM the imported
+    weights: lr=0 keeps params frozen, so the saved checkpoint must equal
+    the converted reference state dict exactly (guards the cli/train.py
+    wiring order — import AFTER vgg init, BEFORE create_train_state)."""
+    import jax
+
+    from can_tpu.data import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path / "train_data"), 8,
+                           sizes=((64, 64),), seed=0)
+    make_synthetic_dataset(str(tmp_path / "test_data"), 8,
+                           sizes=((64, 64),), seed=1)
+    pth = str(tmp_path / "ref.pth")
+    torch.save(ref_model.state_dict(), pth)
+    ck = str(tmp_path / "ck")
+
+    from can_tpu.cli.train import main
+
+    rc = main(["--data_root", str(tmp_path), "--epochs", "1",
+               "--batch-size", "1", "--lr", "0", "--checkpoint-dir", ck,
+               "--init-torch-pth", pth])
+    assert rc == 0
+
+    from can_tpu.models import cannet_init
+    from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+    from can_tpu.utils import CheckpointManager
+
+    state = create_train_state(cannet_init(jax.random.key(0)),
+                               make_optimizer(make_lr_schedule(1e-7)))
+    mgr = CheckpointManager(ck)
+    state = mgr.restore(state)
+    mgr.close()
+    want = convert_state_dict(ref_model.state_dict())
+    np.testing.assert_array_equal(
+        np.asarray(state.params["frontend"][0]["w"]), want["frontend"][0]["w"])
+    np.testing.assert_array_equal(
+        np.asarray(state.params["context"]["s6"]["weight"]),
+        want["context"]["s6"]["weight"])
+    np.testing.assert_array_equal(
+        np.asarray(state.params["output"]["b"]), want["output"]["b"])
+
+
 def test_npz_roundtrip(tmp_path, ref_model):
     params = convert_state_dict(ref_model.state_dict())
     path = str(tmp_path / "can_params.npz")

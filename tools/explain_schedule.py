@@ -58,6 +58,15 @@ def main() -> int:
                          "pass your chip's HBM to match (default: the "
                          "16 GiB v5e the cap was calibrated on)")
     ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--sweep-launch-cost", action="store_true",
+                    help="instead of one explanation, sweep launch-cost "
+                         "pricing over 0..4 Mpx and print where the PLAN "
+                         "actually changes — the sensitivity table behind "
+                         "'--launch-cost-mpx auto needs no correction' "
+                         "(CHANGES.md r5): plans are typically flat below "
+                         "0.05 Mpx (sub-ms hosts) and above ~1 Mpx "
+                         "(tunnels), so only 2.5-25 ms dispatch costs are "
+                         "decision-sensitive")
     args = ap.parse_args()
 
     import math
@@ -80,20 +89,34 @@ def main() -> int:
         cap = max_launch_pixels(bf16=args.bf16,
                                 hbm_bytes=int(args.hbm_gib * 1024 ** 3),
                                 shards=args.dp)
-    b = ShardedBatcher(ds, args.batch_size * args.dp // args.hosts,
-                       shuffle=not args.eval, seed=0,
-                       process_count=args.hosts,
-                       pad_multiple=args.pad_multiple,
-                       max_buckets=args.max_buckets,
-                       remnant_sizes=not args.no_remnant_batches,
-                       batch_quantum=quantum,
-                       launch_cost_px=args.launch_cost_mpx * 1e6,
-                       max_launch_px=cap)
+    common = dict(shuffle=not args.eval, seed=0,
+                  process_count=args.hosts,
+                  pad_multiple=args.pad_multiple,
+                  max_buckets=args.max_buckets,
+                  remnant_sizes=not args.no_remnant_batches,
+                  batch_quantum=quantum, max_launch_px=cap)
+    host_bs = args.batch_size * args.dp // args.hosts
 
     gbs = args.batch_size * args.dp
     print(f"dataset: {len(ds)} images, global batch {gbs} "
           f"(dp={args.dp} x per-replica {args.batch_size}), "
           f"launch quantum {quantum}")
+    if args.sweep_launch_cost:
+        prev = None
+        for mpx in (0.0, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 4.0):
+            bb = ShardedBatcher(ds, host_bs, launch_cost_px=mpx * 1e6,
+                                **common)
+            key = (bb.batches_per_epoch(args.epoch),
+                   round(bb.schedule_overhead(args.epoch), 4),
+                   bb.program_count(args.epoch))
+            mark = ("   (baseline)" if prev is None
+                    else "" if key == prev else "   <-- plan changed")
+            print(f"  launch_cost {mpx:5.2f} Mpx: launches={key[0]:>4} "
+                  f"overhead={key[1]:7.2%} programs={key[2]:>3}{mark}")
+            prev = key
+        return 0
+    b = ShardedBatcher(ds, host_bs,
+                       launch_cost_px=args.launch_cost_mpx * 1e6, **common)
     print(f"buckets: {b.describe_buckets()}")
     sched = b.global_schedule(args.epoch)
     programs = collections.Counter((k, len(g)) for k, g in sched)
