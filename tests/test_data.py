@@ -664,6 +664,53 @@ class TestRemnantSubBatches:
         # and never anything worse than the full-batch cover
         assert d(13, (16, 8, 4, 2, 1), launch_cost=1e12) == (16,)
 
+    def test_decompose_optimality_fuzz(self):
+        """The bottom-up DP returns a TRUE optimum with the documented
+        determinism: for random small instances, its cost equals
+        brute-force search over all covers (priced by area*slots +
+        launch_cost*parts), ties prefer FEWER launches, parts come back
+        descending, and repeated calls are identical — the properties
+        _partial_plan's byte-identical multi-host contract rests on
+        (regression net for the r5 iterative rewrite)."""
+        import itertools
+
+        rng = np.random.default_rng(11)
+
+        def cost(parts, area, lc):
+            return area * sum(parts) + lc * len(parts)
+
+        def brute(n, menu, area, lc):
+            best, best_k = None, None
+            # covers need at most ceil(n/min(menu)) parts; cap for speed
+            for k in range(1, n // min(menu) + 2):
+                for combo in itertools.combinations_with_replacement(
+                        sorted(menu, reverse=True), k):
+                    if sum(combo) >= n:
+                        c = cost(combo, area, lc)
+                        if best is None or c < best - 1e-9:
+                            best, best_k = c, k
+                        elif abs(c - best) <= 1e-9:
+                            best_k = min(best_k, k)
+            return best, best_k
+
+        for _ in range(40):
+            menu = tuple(sorted({int(x) for x in
+                                 rng.choice([1, 2, 3, 4, 6, 8, 12, 16],
+                                            size=rng.integers(1, 4))},
+                                reverse=True))
+            n = int(rng.integers(1, 25))
+            area = float(rng.uniform(0.5, 4.0))
+            lc = float(rng.choice([0.0, 0.5, 2.0, 10.0]))
+            got = ShardedBatcher._decompose(n, menu, area, lc)
+            assert sum(got) >= n, (n, menu, got)
+            assert all(s in menu for s in got)
+            assert got == tuple(sorted(got, reverse=True)), got
+            assert got == ShardedBatcher._decompose(n, menu, area, lc)
+            want_cost, want_k = brute(n, menu, area, lc)
+            assert cost(got, area, lc) == pytest.approx(want_cost), (
+                n, menu, area, lc, got)
+            assert len(got) == want_k, (n, menu, area, lc, got, want_k)
+
     def test_decompose_deep_no_recursion_limit(self):
         # ADVICE r4: the old memoized-recursive DP went ~n/min(menu)
         # frames deep — quantum 1 with a straggler count spanning several
