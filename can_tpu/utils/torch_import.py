@@ -118,6 +118,63 @@ def convert_state_dict(sd: Mapping) -> dict:
     return params
 
 
+def export_state_dict(params: Mapping, *, ddp_prefix: bool = False) -> dict:
+    """can_tpu params -> reference-layout state dict (numpy, OIHW) — the
+    INVERSE of convert_state_dict, so a model trained here can be handed
+    back to a reference user (their test.py:19 loads it as-is; set
+    ddp_prefix for the DDP-saved form their train.py:161 produces).
+
+    Exact inverse by construction: convert_state_dict(export_state_dict(p))
+    round-trips bit-identically (tests/test_torch_import.py).
+    Only the plain (non-BN) model exports — the reference has no BN keys.
+    """
+    from can_tpu.models.cannet import has_batch_norm
+
+    if has_batch_norm(params):
+        raise ValueError("reference layout has no BatchNorm; "
+                         "cannot export a --syncBN model")
+
+    def oihw(w):
+        return np.transpose(np.asarray(w, dtype=np.float32), (3, 2, 0, 1))
+
+    sd: dict = {}
+    for k, p in zip(FRONTEND_SEQ_IDX, params["frontend"]):
+        sd[f"frontend.{k}.weight"] = oihw(p["w"])
+        sd[f"frontend.{k}.bias"] = np.asarray(p["b"], dtype=np.float32)
+    for k, p in zip(BACKEND_SEQ_IDX, params["backend"]):
+        sd[f"backend.{k}.weight"] = oihw(p["w"])
+        sd[f"backend.{k}.bias"] = np.asarray(p["b"], dtype=np.float32)
+    sd["output_layer.weight"] = oihw(params["output"]["w"])
+    sd["output_layer.bias"] = np.asarray(params["output"]["b"],
+                                         dtype=np.float32)
+    for s in CONTEXT_SCALES:
+        cp = params["context"][f"s{s}"]
+        # (Cin, Cout) matmul matrix -> (O, I, 1, 1) conv weight
+        sd[f"conv{s}_1.weight"] = np.asarray(
+            cp["ave"], dtype=np.float32).T[:, :, None, None].copy()
+        sd[f"conv{s}_2.weight"] = np.asarray(
+            cp["weight"], dtype=np.float32).T[:, :, None, None].copy()
+    # reference registration order (frontend, backend, output, conv{s}_{j})
+    # so ordinal-position consumers see the exact layout
+    spec = reference_param_shapes()
+    ordered = {k: sd[k] for k in spec}
+    if ddp_prefix:
+        ordered = {f"module.{k}": v for k, v in ordered.items()}
+    return ordered
+
+
+def save_torch_checkpoint(params: Mapping, path: str, *,
+                          ddp_prefix: bool = False) -> None:
+    """torch.save a reference-layout checkpoint of ``params``."""
+    import torch
+
+    # np.copy: jax-backed arrays are non-writable views, which
+    # torch.from_numpy warns about (torch tensors assume ownership)
+    sd = {k: torch.from_numpy(np.copy(v)) for k, v in
+          export_state_dict(params, ddp_prefix=ddp_prefix).items()}
+    torch.save(sd, path)
+
+
 def load_torch_checkpoint(path: str) -> dict:
     """``torch.load`` a reference checkpoint file -> can_tpu params.
 

@@ -1,0 +1,82 @@
+"""Export a can_tpu checkpoint as a reference-layout torch ``.pth``.
+
+The reverse of ``import_torch_checkpoint.py``: a model trained HERE
+becomes a checkpoint any reference user can load with their unmodified
+``test.py`` (reference test.py:19 ``model.load_state_dict``) — migration
+is a two-way door, not a lock-in.
+
+    python tools/export_torch_checkpoint.py --checkpoint-dir ./checkpoints \\
+        --out epoch_best.pth [--epoch N] [--ddp-prefix]
+
+``--ddp-prefix`` writes ``module.``-prefixed keys (the form the
+reference's DDP training loop saves, train.py:161).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-dir", required=True,
+                    help="Orbax checkpoint dir (the train CLI's output)")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="epoch to export (default: best by MAE, else latest)")
+    ap.add_argument("--out", default="exported.pth")
+    ap.add_argument("--ddp-prefix", action="store_true",
+                    help="write module.-prefixed keys (reference DDP form)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side tensor shuffling
+
+    from can_tpu.models import cannet_init, init_batch_stats
+    from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
+    from can_tpu.utils import CheckpointManager
+    from can_tpu.utils.torch_import import save_torch_checkpoint
+
+    mgr = CheckpointManager(args.checkpoint_dir)
+    epoch = args.epoch
+    if epoch is None:
+        epoch = mgr.best_epoch()
+    if epoch is None:
+        epoch = mgr.latest_epoch()
+    if epoch is None:
+        raise SystemExit(f"no checkpoints in {args.checkpoint_dir}")
+
+    def restore(batch_norm):
+        params = cannet_init(jax.random.key(0), batch_norm=batch_norm)
+        state = create_train_state(params,
+                                   make_optimizer(make_lr_schedule(1e-7)),
+                                   init_batch_stats(params))
+        return mgr.restore(state, epoch=epoch)
+
+    try:
+        state = restore(False)
+    except Exception:
+        # the friendly diagnosis: if the BN skeleton restores, this is a
+        # --syncBN checkpoint — say so instead of the opaque Orbax
+        # tree-structure error (review r5)
+        try:
+            restore(True)
+        except Exception:
+            raise  # genuinely corrupt/mismatched: surface the Orbax error
+        raise SystemExit(
+            "checkpoint holds the --syncBN (BatchNorm) model; the "
+            "reference layout has no BN — cannot export it as a "
+            "reference .pth")
+    finally:
+        mgr.close()
+    save_torch_checkpoint(state.params, args.out, ddp_prefix=args.ddp_prefix)
+    print(f"exported epoch {epoch} -> {args.out} "
+          f"({'DDP' if args.ddp_prefix else 'bare'} reference layout)")
+
+
+if __name__ == "__main__":
+    main()
