@@ -99,7 +99,11 @@ def _kernel(fv_ref, *rest):
 
 try:  # import guard: pallas TPU lowering is unavailable on some backends
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as _pltpu  # noqa: F401 probe —
+    # importing the TPU lowering is the availability check itself; without
+    # it _PALLAS_OK would be True on builds where pallas imports but TPU
+    # lowering doesn't, and pallas_call would raise at trace time instead
+    # of supports() steering callers to the fallback
 
     _PALLAS_OK = True
 except ImportError:  # pragma: no cover

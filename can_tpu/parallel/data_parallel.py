@@ -22,7 +22,7 @@ one at utils/train_eval_utils.py:39,136).
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import numpy as np

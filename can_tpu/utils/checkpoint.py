@@ -12,10 +12,7 @@ replicated params this reduces to primary-only writes).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
-
-import jax
-import numpy as np
+from typing import Optional
 
 from can_tpu.train.state import TrainState
 

@@ -9,7 +9,6 @@ std is 0.225, train_eval_utils.py:92-95) and takes NHWC numpy arrays.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import numpy as np
 

@@ -1,12 +1,11 @@
 """Flax facade: init/apply interop with the functional core."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
-from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
+from can_tpu.models import cannet_apply, cannet_init
 from can_tpu.models.flax_module import (
     CANNet,
     functional_batch_stats,

@@ -18,7 +18,6 @@ from can_tpu.train import (
     NonFiniteLossError,
     create_train_state,
     evaluate,
-    make_eval_step,
     make_lr_schedule,
     make_optimizer,
     make_train_step,

@@ -23,7 +23,6 @@ from can_tpu.data import (
     normalize_host,
     pad_batch,
 )
-from can_tpu.data.dataset import IMAGENET_STD
 from can_tpu.models import cannet_apply, cannet_init
 from can_tpu.parallel import (
     make_dp_eval_step,
