@@ -41,11 +41,25 @@ BASELINE_IMG_PER_S_H100 = 25.0
 
 
 def main() -> None:
-    # fail fast if backend acquisition hangs (dead tunnel) — one stderr
-    # line and exit 3 beats a silently hung driver
-    from can_tpu.utils import await_devices
+    # config is known before any device touch: the timeout null line can
+    # carry the SAME parameterized metric name a successful run would,
+    # so artifact consumers see a null in the real series, not a gap
+    b = int(os.environ.get("BENCH_BATCH", "16"))
+    h = int(os.environ.get("BENCH_H", "576"))
+    w = int(os.environ.get("BENCH_W", "768"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = 3
+    f32 = bool(os.environ.get("BENCH_F32"))
+    metric = (f"cannet_train_img_per_s_{h}x{w}_b{b}"
+              f"{'_f32' if f32 else '_bf16'}")
 
-    await_devices()
+    # fail fast if backend acquisition hangs (dead tunnel) — one stderr
+    # line and exit 3 beats a silently hung driver; the JSON null line
+    # makes the recorded artifact self-describing (r5)
+    from can_tpu.utils import await_devices, emit_null_result
+
+    await_devices(on_timeout=emit_null_result(
+        metric, unit="images/sec", vs_baseline=None))
     import jax
     import jax.numpy as jnp
 
@@ -62,12 +76,7 @@ def main() -> None:
     from can_tpu.data.batching import Batch
     from can_tpu.train import create_train_state, make_lr_schedule, make_optimizer
 
-    b = int(os.environ.get("BENCH_BATCH", "16"))
-    h = int(os.environ.get("BENCH_H", "576"))
-    w = int(os.environ.get("BENCH_W", "768"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = 3
-    compute_dtype = None if os.environ.get("BENCH_F32") else jnp.bfloat16
+    compute_dtype = None if f32 else jnp.bfloat16
 
     apply_fn = cannet_apply
     ndev = jax.device_count()
@@ -104,8 +113,7 @@ def main() -> None:
     img_per_s = local_b * steps / dt
     per_chip = img_per_s / ndev
     print(json.dumps({
-        "metric": f"cannet_train_img_per_s_{h}x{w}_b{b}"
-                  f"{'_f32' if compute_dtype is None else '_bf16'}",
+        "metric": metric,
         "value": round(img_per_s, 3),
         "unit": "images/sec",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_H100, 3),

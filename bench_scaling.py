@@ -90,9 +90,10 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax  # noqa: F811
 
-    from can_tpu.utils import await_devices, enable_compilation_cache
+    from can_tpu.utils import await_devices, emit_null_result, enable_compilation_cache
 
-    await_devices()  # fail fast on a dead tunnel instead of hanging
+    # fail fast on a dead tunnel, leaving a machine-readable null line
+    await_devices(on_timeout=emit_null_result("bench_scaling"))
     enable_compilation_cache()
 
     ndev = jax.device_count()

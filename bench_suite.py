@@ -406,9 +406,10 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    from can_tpu.utils import await_devices
+    from can_tpu.utils import await_devices, emit_null_result
 
-    await_devices()  # fail fast on a dead tunnel instead of hanging
+    # fail fast on a dead tunnel, leaving a machine-readable null line
+    await_devices(on_timeout=emit_null_result("bench_suite"))
     import jax  # noqa: F811
     import jax.numpy as jnp
 

@@ -2,7 +2,13 @@ from .checkpoint import CheckpointManager
 from .compile_cache import default_cache_dir, enable_compilation_cache
 from .logging import MetricLogger
 from .viz import save_density_visualization
-from .profiling import StepTimer, await_devices, device_watchdog, profile_trace
+from .profiling import (
+    StepTimer,
+    await_devices,
+    device_watchdog,
+    emit_null_result,
+    profile_trace,
+)
 
 __all__ = [
     "CheckpointManager",
@@ -14,4 +20,5 @@ __all__ = [
     "default_cache_dir",
     "await_devices",
     "device_watchdog",
+    "emit_null_result",
 ]

@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 
 
-def device_watchdog(seconds: float = 300.0):
+def device_watchdog(seconds: float = 300.0, on_timeout=None):
     """Fail FAST if JAX backend/device acquisition hangs.
 
     A dead accelerator tunnel makes ``jax.devices()`` block forever with
@@ -33,6 +33,12 @@ def device_watchdog(seconds: float = 300.0):
     right after ``jax.devices()`` returns; if it isn't set within
     ``seconds`` the process prints one clear stderr line and exits 3.
     Generous default: a cold tunnel handshake is legitimately slow.
+
+    ``on_timeout``: optional callback run before the exit — benchmark
+    entry points use it to emit a machine-readable null result so the
+    driver's artifact records WHY there is no number (r5; the bare rc=3
+    of r4 took a human to interpret).  Exceptions in it are swallowed:
+    the exit must happen regardless.
     """
     armed = threading.Event()
 
@@ -40,6 +46,11 @@ def device_watchdog(seconds: float = 300.0):
         if not armed.wait(seconds):
             import sys
 
+            if on_timeout is not None:
+                try:
+                    on_timeout()
+                except Exception:
+                    pass
             print(f"[watchdog] FATAL: no JAX device within {seconds:.0f}s "
                   f"— accelerator backend unreachable", file=sys.stderr,
                   flush=True)
@@ -49,13 +60,30 @@ def device_watchdog(seconds: float = 300.0):
     return armed
 
 
-def await_devices(seconds: float = 300.0):
+def emit_null_result(metric: str, **extra):
+    """on_timeout callback factory for benchmark entry points: print one
+    machine-readable null-result line before the watchdog exit, so the
+    recorded artifact says WHY there is no number instead of a bare
+    rc=3 (r5).  Usage: ``await_devices(on_timeout=emit_null_result(...))``."""
+
+    def emit():
+        import json
+
+        print(json.dumps(dict(
+            {"metric": metric, "value": None,
+             "error": "accelerator backend unreachable (watchdog timeout)"},
+            **extra)), flush=True)
+
+    return emit
+
+
+def await_devices(seconds: float = 300.0, on_timeout=None):
     """Arm the watchdog, force backend init, disarm; returns devices.
     One call at the top of every benchmark entry point.  Disarms in
     ``finally``: a backend that RAISES (refused connection) instead of
     hanging must not leave the timer to kill the caller's fallback path
     minutes later."""
-    armed = device_watchdog(seconds)
+    armed = device_watchdog(seconds, on_timeout=on_timeout)
     try:
         return jax.devices()
     finally:

@@ -376,6 +376,31 @@ class TestDeviceWatchdog:
         assert "watchdog" in proc.stderr
         assert "should never" not in proc.stdout
 
+    def test_on_timeout_emits_before_exit(self):
+        # bench.py uses this to leave a machine-readable null result in
+        # the driver's artifact instead of a bare rc=3 (r5)
+        import subprocess
+        import sys
+
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import time\n"
+            "from can_tpu.utils import device_watchdog\n"
+            "device_watchdog(1.0, on_timeout=lambda: "
+            "print('{\"value\": null}', flush=True))\n"
+            "time.sleep(30)\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=25)
+        assert proc.returncode == 3, (proc.returncode, proc.stderr)
+        assert '"value": null' in proc.stdout
+        # a broken callback must not mask the exit
+        code_bad = code.replace("print('{\"value\": null}', flush=True)",
+                                "1 / 0")
+        proc = subprocess.run([sys.executable, "-c", code_bad],
+                              capture_output=True, text=True, timeout=25)
+        assert proc.returncode == 3, (proc.returncode, proc.stderr)
+
     def test_disarms_on_exception(self):
         # a backend that RAISES (refused connection) must not leave the
         # timer to kill the caller's fallback path later (code-review

@@ -109,9 +109,10 @@ def main() -> int:
     if args.platform != "cpu":
         # fail fast on a dead tunnel instead of hanging (CPU runs must
         # NOT touch the default backend before --platform cpu applies)
-        from can_tpu.utils import await_devices
+        from can_tpu.utils import await_devices, emit_null_result
 
-        await_devices()
+        await_devices(on_timeout=emit_null_result(
+            "convergence_tpu_part_a_histogram"))
     root = args.root or tempfile.mkdtemp(prefix="can_tpu_conv_bench_")
     try:
         res = run(root, platform=args.platform, scale=args.scale)

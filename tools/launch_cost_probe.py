@@ -35,9 +35,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    from can_tpu.utils import await_devices
+    from can_tpu.utils import await_devices, emit_null_result
 
-    await_devices()
+    await_devices(on_timeout=emit_null_result("launch_cost_probe"))
     import jax
     import jax.numpy as jnp
 

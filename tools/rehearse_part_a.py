@@ -172,9 +172,9 @@ def main() -> int:
     if args.platform != "cpu":
         # fail fast on a dead tunnel instead of hanging (CPU runs must
         # not touch the default backend before --platform cpu applies)
-        from can_tpu.utils import await_devices
+        from can_tpu.utils import await_devices, emit_null_result
 
-        await_devices()
+        await_devices(on_timeout=emit_null_result("part_a_rehearsal"))
     res = run(args.root, epochs=args.epochs, scale=args.scale,
               platform=args.platform, lr=args.lr)
     print(f"[rehearsal] eval MAEs per epoch: {res['maes']}")
