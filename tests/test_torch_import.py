@@ -255,6 +255,42 @@ def test_npz_roundtrip(tmp_path, ref_model):
         np.asarray(cannet_apply(again, jnp.asarray(x))))
 
 
+def test_eval_cli_params_npz_matches_torch_pth(tmp_path, ref_model):
+    """The two eval-CLI import paths — --torch-pth (direct) and
+    --params-npz (the torch-free converted file) — must report identical
+    metrics for the same weights, end to end through the CLI.
+
+    This pins the WIRING (flags -> loader -> device_put -> evaluate); the
+    printed metrics compare at the CLI's 3-decimal precision.  Bit-exact
+    weight equality across the npz round trip is asserted separately in
+    test_npz_roundtrip, so a sub-millidigit numeric divergence cannot
+    hide here without failing there."""
+    import contextlib
+    import io
+    import re
+
+    from can_tpu.cli.test import main as test_main
+    from can_tpu.data import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path / "test_data"), 4,
+                           sizes=((64, 64),), seed=2)
+    pth = str(tmp_path / "ref.pth")
+    torch.save(ref_model.state_dict(), pth)
+    npz = str(tmp_path / "can.npz")
+    save_params_npz(convert_state_dict(ref_model.state_dict()), npz)
+
+    def run(flags):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = test_main(["--data_root", str(tmp_path)] + flags)
+        assert rc == 0
+        m = re.search(r"MAE=([\d.]+) MSE=([\d.]+)", buf.getvalue())
+        assert m, buf.getvalue()
+        return m.groups()
+
+    assert run(["--torch-pth", pth]) == run(["--params-npz", npz])
+
+
 def test_export_is_exact_inverse(tmp_path, ref_model):
     """The reverse direction: can_tpu params -> reference-layout .pth.
     Export must bit-identically round-trip through import, reproduce the
