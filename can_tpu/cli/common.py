@@ -135,12 +135,54 @@ def activation_bytes(batch: int, h: int, w: int, *,
     return int(batch * h * w * per_px)
 
 
+# HBM per JAX device by hardware generation — spec constants, not guesses
+# (substring-matched against ``device_kind``).  Exists because not every
+# PJRT client implements memory_stats(): the axon-tunnelled v5e returns
+# nothing, and in the r5 chip run that silently disabled BOTH fits-in-HBM
+# mechanisms (max_launch_pixels -> None, remat policy -> never), letting
+# the b16 x 1016x1024 varres launch compile at 16.97 GiB and OOM a
+# 15.75 GiB chip.  A device whose kind is unknown still returns None.
+# ORDERED: lite/cost-optimised variants before their generation's bare
+# entry, so "v5lite..." never hits the bare "v5" (v5p) row and "v4i"
+# never gets a full v4's 32 GiB.
+_HBM_BY_DEVICE_KIND = (
+    ("v5lite", 16 << 30),    # v5e ("TPU v5 lite", "TPU v5litepod-N")
+    ("v5e", 16 << 30),
+    ("v5p", 95 << 30),
+    ("v5", 95 << 30),        # bare "TPU v5" = v5p (v5e always says lite/e)
+    ("v6lite", 32 << 30),    # Trillium
+    ("v6e", 32 << 30),
+    ("v4i", 8 << 30),
+    ("v4lite", 8 << 30),
+    ("v4", 32 << 30),
+    ("v3", 16 << 30),        # per core (= per JAX device)
+    ("v2", 8 << 30),
+)
+
+
+def hbm_bytes_for_device_kind(kind: str) -> Optional[int]:
+    """Spec HBM bytes for a TPU ``device_kind`` string, or None if the
+    generation isn't recognised.  Matched case-insensitively with spaces
+    stripped, first entry wins ("TPU v5 lite" and "TPU v5litepod-8" both
+    hit "v5lite"; bare "TPU v5" falls through to the v5p row)."""
+    k = kind.lower().replace(" ", "")
+    for sub, size in _HBM_BY_DEVICE_KIND:
+        if sub in k:
+            return size
+    return None
+
+
 def device_memory_bytes() -> Optional[int]:
-    """Per-LOCAL-device HBM (bytes_limit), or None when the backend doesn't
-    report one (CPU).  Callers must treat None as 'no device memory
-    ceiling' — inventing a number here would let a fictitious 16 GiB
-    drive real scheduling (launch caps, remat, LR-schedule step counts)
-    on backends whose only limit is host RAM.
+    """Per-LOCAL-device HBM: ``memory_stats()['bytes_limit']`` when the
+    PJRT client reports it, else the spec size for the device kind
+    (``hbm_bytes_for_device_kind``), else None.  None means 'no device
+    memory ceiling' (CPU): there, inventing a number would let a
+    fictitious 16 GiB drive real scheduling (launch caps, remat,
+    LR-schedule step counts) on backends whose only limit is host RAM.
+    TPU generations are different — their HBM is a hardware constant, and
+    the spec fallback is what keeps the fits-in-HBM machinery alive on
+    clients that don't implement memory_stats (the axon tunnel; see
+    _HBM_BY_DEVICE_KIND).
 
     ``jax.local_devices()``, not ``jax.devices()``: on a multi-host pod
     devices()[0] is non-addressable for every rank but 0, so its
@@ -148,9 +190,24 @@ def device_memory_bytes() -> Optional[int]:
     whether an HBM cap exists (ADVICE r4, high).  Multi-host callers
     must still AGREE the value — use agreed_device_memory_bytes()."""
     try:
-        stats = jax.local_devices()[0].memory_stats()
+        dev = jax.local_devices()[0]
+    except Exception:
+        return None  # backend init failure degrades to 'no ceiling'
+    try:
+        stats = dev.memory_stats()
         if stats and stats.get("bytes_limit"):
             return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    try:
+        if dev.platform == "tpu":
+            spec = hbm_bytes_for_device_kind(dev.device_kind)
+            if spec is None:
+                print(f"[hbm] TPU device_kind {dev.device_kind!r} not in "
+                      "the spec table and memory_stats() reports no "
+                      "bytes_limit: no HBM cap will be applied",
+                      flush=True)
+            return spec
     except Exception:
         pass
     return None

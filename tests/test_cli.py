@@ -266,6 +266,35 @@ class TestRematPolicy:
         assert 16 * 768 * 1024 <= cap     # dominant bench cell at b16
         assert 16 * 1016 * 1024 > cap     # the measured OOM
 
+    def test_hbm_spec_fallback_by_device_kind(self):
+        # r5 chip-run regression: the axon-tunnelled v5e's PJRT client
+        # returns no memory_stats, which silently disabled the pixel cap
+        # AND auto-remat -> the b16 x 1016x1024 launch compiled at
+        # 16.97 GiB and OOM'd the chip.  The spec table keeps the
+        # fits-in-HBM machinery alive on such clients.
+        from can_tpu.cli.common import hbm_bytes_for_device_kind, max_launch_pixels
+
+        assert hbm_bytes_for_device_kind("TPU v5 lite") == 16 << 30
+        assert hbm_bytes_for_device_kind("TPU v5litepod-16") == 16 << 30
+        assert hbm_bytes_for_device_kind("TPU v5e") == 16 << 30
+        assert hbm_bytes_for_device_kind("TPU v5p") == 95 << 30
+        # real v5p clients report bare "TPU v5" (v5e always says lite/e)
+        assert hbm_bytes_for_device_kind("TPU v5") == 95 << 30
+        assert hbm_bytes_for_device_kind("TPU v4") == 32 << 30
+        # lite/inference variants must NOT inherit the full part's HBM
+        assert hbm_bytes_for_device_kind("TPU v4i") == 8 << 30
+        assert hbm_bytes_for_device_kind("TPU v4 lite") == 8 << 30
+        assert hbm_bytes_for_device_kind("TPU v3") == 16 << 30
+        assert hbm_bytes_for_device_kind("cpu") is None
+        assert hbm_bytes_for_device_kind("Fancy NPU 9000") is None
+        # the spec-derived cap must reject the measured OOM launch and
+        # admit the known fits, same as the bytes_limit-derived one
+        cap = max_launch_pixels(
+            bf16=True, hbm_bytes=hbm_bytes_for_device_kind("TPU v5 lite"))
+        assert 16 * 1016 * 1024 > cap
+        assert 8 * 1016 * 1024 <= cap
+        assert 16 * 768 * 1024 <= cap
+
     def test_no_fictitious_memory_on_cpu(self):
         # CPU backends report no bytes_limit: the cap and auto-remat must
         # disable rather than run off an invented 16 GiB (code-review r4)

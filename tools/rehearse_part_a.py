@@ -180,14 +180,23 @@ def main() -> int:
     print(f"[rehearsal] eval MAEs per epoch: {res['maes']}")
     print(f"[rehearsal] best-checkpoint eval CLI: rc={res['eval_rc']} "
           f"MAE={res['eval_mae']:.3f}")
-    # the recipe checkpoints/evaluates the BEST epoch, so judge later
-    # epochs against the first (the last alone may regress on a short
-    # noisy rehearsal); strict, so a diverging run can't pass vacuously
+    # The gate's job is catching divergence (lr too high for the pixel
+    # scale — the r4 finding) and chain breakage, NOT demanding visible
+    # progress after epoch 0 on a short rehearsal: at full scale with the
+    # reference's 500-epoch lr (1e-7), the r5 chip run hit its floor in
+    # epoch 0 (MAE 9.43) and wiggled <2% after — a healthy run the old
+    # strict-improvement check called FAILED.  So: later epochs must
+    # either improve on the first or stay within a 5% band of it; a
+    # diverging run (MAEs climbing past the band) still fails.
     maes = res["maes"]
+    improved = len(maes) > 1 and min(maes[1:]) < maes[0]
+    flat = len(maes) > 1 and max(maes[1:]) <= maes[0] * 1.05
     ok = (res["eval_rc"] == 0 and np.isfinite(res["eval_mae"])
-          and len(maes) > 1 and min(maes[1:]) < maes[0])
+          and (improved or flat))
+    verdict = ("executes end to end"
+               + ("" if improved else " (MAE flat at floor from epoch 0)"))
     print(f"[rehearsal] {'OK' if ok else 'FAILED'} — recipe chain "
-          f"{'executes end to end' if ok else 'broke'}")
+          f"{verdict if ok else 'broke'}")
     return 0 if ok else 1
 
 
