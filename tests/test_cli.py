@@ -295,6 +295,42 @@ class TestRematPolicy:
         assert 8 * 1016 * 1024 <= cap
         assert 16 * 768 * 1024 <= cap
 
+    def test_device_memory_bytes_spec_fallback_branch(self, monkeypatch):
+        # drive device_memory_bytes() itself through the stats-less-TPU
+        # branch (the pure kind->bytes map is covered above): a device
+        # that reports no memory_stats but is a known TPU kind must get
+        # the spec size; an unknown TPU kind must get None (with the
+        # warning), never a guess
+        import can_tpu.cli.common as common
+
+        class FakeDev:
+            platform = "tpu"
+
+            def __init__(self, kind, stats=None):
+                self.device_kind = kind
+                self._stats = stats
+
+            def memory_stats(self):
+                return self._stats
+
+        monkeypatch.setattr(common.jax, "local_devices",
+                            lambda: [FakeDev("TPU v5 lite")])
+        assert common.device_memory_bytes() == 16 << 30
+        # a reported bytes_limit always wins over the spec table
+        monkeypatch.setattr(
+            common.jax, "local_devices",
+            lambda: [FakeDev("TPU v5 lite", {"bytes_limit": 123})])
+        assert common.device_memory_bytes() == 123
+        monkeypatch.setattr(common.jax, "local_devices",
+                            lambda: [FakeDev("TPU v99 quantum")])
+        assert common.device_memory_bytes() is None
+        # backend enumeration failure degrades to None, never raises
+        def boom():
+            raise RuntimeError("backend init failed")
+
+        monkeypatch.setattr(common.jax, "local_devices", boom)
+        assert common.device_memory_bytes() is None
+
     def test_no_fictitious_memory_on_cpu(self):
         # CPU backends report no bytes_limit: the cap and auto-remat must
         # disable rather than run off an invented 16 GiB (code-review r4)
