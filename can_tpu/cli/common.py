@@ -202,7 +202,8 @@ def device_memory_bytes() -> Optional[int]:
     try:
         if dev.platform == "tpu":
             spec = hbm_bytes_for_device_kind(dev.device_kind)
-            if spec is None:
+            if spec is None and dev.device_kind not in _WARNED_KINDS:
+                _WARNED_KINDS.add(dev.device_kind)
                 print(f"[hbm] TPU device_kind {dev.device_kind!r} not in "
                       "the spec table and memory_stats() reports no "
                       "bytes_limit: no HBM cap will be applied",
@@ -211,6 +212,9 @@ def device_memory_bytes() -> Optional[int]:
     except Exception:
         pass
     return None
+
+
+_WARNED_KINDS: set = set()
 
 
 def agreed_device_memory_bytes() -> Optional[int]:

@@ -148,6 +148,15 @@ def main(argv=None) -> int:
     if (args.torch_pth or args.params_npz) and args.syncBN:
         raise SystemExit("--torch-pth/--params-npz hold the reference "
                          "model (no BatchNorm); drop --syncBN")
+    # imported params are a complete model: checkpoint-selection flags
+    # would be silently ignored, so reject them like the conflicts above
+    if (args.torch_pth or args.params_npz) and args.epoch is not None:
+        raise SystemExit("--epoch selects an Orbax checkpoint epoch; it "
+                         "does not apply to --torch-pth/--params-npz")
+    if (args.torch_pth or args.params_npz) \
+            and args.checkpoint_dir != "./checkpoints":
+        raise SystemExit("--checkpoint-dir is ignored with "
+                         "--torch-pth/--params-npz; drop one of them")
     for p in (args.torch_pth, args.params_npz):
         if p and not _os.path.isfile(p):
             raise SystemExit(f"no such checkpoint file: {p}")

@@ -39,8 +39,24 @@ import numpy as np
 from can_tpu.models.cannet import BACKEND_CFG, CONTEXT_SCALES, FRONTEND_CFG, _FEAT_CH
 
 # Sequential indices of the conv layers inside each make_layers stack.
+# The SINGLE home of the load-bearing VGG-16 feature-stack positions —
+# tools/convert_vgg16.py imports FRONTEND_SEQ_IDX rather than keeping a
+# copy that could drift.
 FRONTEND_SEQ_IDX: Tuple[int, ...] = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21)
 BACKEND_SEQ_IDX: Tuple[int, ...] = (0, 2, 4, 6, 8, 10)
+
+
+def _to_f32_array(v) -> np.ndarray:
+    """Tensor-or-array -> float32 numpy.  Goes through torch's ``.float()``
+    first when available: ``.numpy()`` on half/bf16 tensors raises an
+    opaque 'unsupported ScalarType', and checkpoints re-saved at reduced
+    precision are common in the wild — everything is cast to f32 here
+    anyway."""
+    if hasattr(v, "float"):          # torch tensor (any dtype, any device)
+        v = v.detach().cpu().float()
+    if hasattr(v, "numpy"):
+        v = v.numpy()
+    return np.asarray(v, dtype=np.float32)
 
 
 def reference_param_shapes() -> Dict[str, Tuple[int, ...]]:
@@ -82,8 +98,7 @@ def convert_state_dict(sd: Mapping) -> dict:
     nothing (the reference's own ``strict=False`` resume bug, SURVEY §5).
     """
     sd = _strip_prefix(sd)
-    arrays = {k: np.asarray(getattr(v, "numpy", lambda: v)(), dtype=np.float32)
-              for k, v in sd.items()}
+    arrays = {k: _to_f32_array(v) for k, v in sd.items()}
     spec = reference_param_shapes()
     missing = sorted(set(spec) - set(arrays))
     unexpected = sorted(set(arrays) - set(spec))

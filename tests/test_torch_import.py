@@ -129,6 +129,23 @@ def test_strict_validation():
         convert_state_dict(bad)
 
 
+def test_reduced_precision_checkpoints_import(ref_model):
+    """Checkpoints re-saved at half/bf16 (common for distribution) must
+    import — .numpy() on them raises an opaque ScalarType error unless
+    the importer goes through .float() first (code-review r5)."""
+    import torch
+
+    f32 = convert_state_dict(ref_model.state_dict())
+    for dtype in (torch.float16, torch.bfloat16):
+        sd = {k: v.to(dtype) for k, v in ref_model.state_dict().items()}
+        params = convert_state_dict(sd)
+        # values equal the f32 import up to the precision of the storage
+        np.testing.assert_allclose(
+            params["frontend"][0]["w"], f32["frontend"][0]["w"],
+            rtol=1e-2, atol=1e-2)
+        assert params["frontend"][0]["w"].dtype == np.float32
+
+
 def test_vgg16_manifest_pins_layout():
     """tools/convert_vgg16.py validates .pth layout against the committed
     manifest (VERDICT r4 missing-5): matching dicts pass, drifted key
@@ -199,6 +216,27 @@ def test_train_cli_warm_start_flag_validation(tmp_path):
     with pytest.raises(SystemExit, match="no such checkpoint"):
         main(["--data_root", str(tmp_path),
               "--init-torch-pth", str(tmp_path / "missing.pth")])
+
+
+def test_eval_cli_import_flags_reject_checkpoint_selection(tmp_path):
+    """--torch-pth/--params-npz are complete models: --epoch and a
+    non-default --checkpoint-dir would be silently ignored, so the eval
+    CLI rejects them like its other conflicting combinations
+    (code-review r5)."""
+    from can_tpu.data import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path / "test_data"), 1,
+                           sizes=((64, 64),), seed=0)
+    pth = tmp_path / "ckpt.pth"
+    pth.write_bytes(b"not-read-during-validation")
+
+    from can_tpu.cli.test import main
+
+    base = ["--data_root", str(tmp_path), "--torch-pth", str(pth)]
+    with pytest.raises(SystemExit, match="--epoch"):
+        main(base + ["--epoch", "7"])
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        main(base + ["--checkpoint-dir", str(tmp_path / "ck")])
 
 
 def test_train_cli_warm_start_happy_path(tmp_path, ref_model):

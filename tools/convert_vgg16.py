@@ -20,12 +20,16 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # torchvision vgg16 'features' indices of the first 10 conv layers
-# (conv positions in the [64,64,M,128,128,M,256,256,256,M,512,512,512] stack).
-VGG16_CONV_FEATURE_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21)
+# (conv positions in the [64,64,M,128,128,M,256,256,256,M,512,512,512]
+# stack) — single home in torch_import so the two converters can't drift.
+from can_tpu.utils.torch_import import FRONTEND_SEQ_IDX as VGG16_CONV_FEATURE_IDX  # noqa: E402
 
 MANIFEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "vgg16_manifest.json")

@@ -55,7 +55,19 @@ def main() -> None:
     mesh = make_mesh()
     opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
     repeats = int(os.environ.get("LAUNCH_PROBE_REPEATS", "10"))
-    shapes = ((1, 64, 64), (1, 128, 128), (2, 128, 128), (2, 192, 256))
+    # The fit needs shapes whose COMPUTE spans well past the per-step
+    # noise (~±8 ms on the tunnel), or slope and intercept are not
+    # identifiable (code-review r5: the original ≤0.098 Mpx sweep put
+    # ~2 ms of compute against ±8 ms noise and fitted noise).  On an
+    # accelerator, go up to the headline shape (7.08 Mpx ≈ 170 ms of
+    # compute at the measured ~42 Mpx/s); the CPU backend keeps the tiny
+    # sweep — its fixed cost is optimizer-update-dominated either way
+    # and big shapes would take minutes per step on one core.
+    if jax.devices()[0].platform == "cpu":
+        shapes = ((1, 64, 64), (1, 128, 128), (2, 128, 128), (2, 192, 256))
+    else:
+        shapes = ((1, 64, 64), (2, 192, 256), (4, 576, 768),
+                  (8, 576, 768), (16, 576, 768))
     rng = np.random.default_rng(0)
     xs, ts = [], []
     for b, h, w in shapes:
@@ -92,6 +104,7 @@ def main() -> None:
     # t(px) = launch + px / rate
     slope, intercept = np.polyfit(xs, ts, 1)
     rate_mpx_s = 1e3 / slope if slope > 0 else float("inf")
+    resid_ms = float(np.std(np.array(ts) - (slope * np.array(xs) + intercept)))
     out = {
         "platform": jax.devices()[0].platform,
         "probe_ms": round(probe_ms, 3),
@@ -99,6 +112,7 @@ def main() -> None:
         "ratio_step_over_probe": round(float(intercept) / probe_ms, 2)
         if probe_ms > 0 else None,
         "fit_rate_mpx_per_s": round(rate_mpx_s, 1),
+        "fit_resid_ms": round(resid_ms, 2),
         "shapes_ms": dict(zip([f"b{b}_{h}x{w}" for b, h, w in shapes],
                               [round(t, 2) for t in ts])),
     }

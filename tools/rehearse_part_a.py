@@ -144,8 +144,17 @@ def run(root: str, *, epochs: int = 3, scale: float = 1.0,
         eval_rc = test_main(eval_argv)
     m = re.search(r"MAE=([0-9.eE+-]+)", ebuf.getvalue())
     eval_mae = float(m.group(1)) if m else float("nan")
+
+    # MAE of a predict-zero model on the test split (= mean GT count):
+    # the absolute learned-ness bar for the gate — "flat" is only a
+    # floor if the flat level actually beats not predicting at all
+    import glob
+
+    gts = sorted(glob.glob(os.path.join(root, "test_data", "ground_truth",
+                                        "*.npy")))
+    zero_mae = float(np.mean([abs(float(np.load(g).sum())) for g in gts]))
     return {"maes": maes, "best_mae": min(maes), "eval_rc": eval_rc,
-            "eval_mae": eval_mae}
+            "eval_mae": eval_mae, "zero_mae": zero_mae}
 
 
 def main() -> int:
@@ -191,8 +200,20 @@ def main() -> int:
     maes = res["maes"]
     improved = len(maes) > 1 and min(maes[1:]) < maes[0]
     flat = len(maes) > 1 and max(maes[1:]) <= maes[0] * 1.05
+    # absolute learned-ness bar: flat (or improved) is only meaningful if
+    # the level beats a predict-zero model — a frozen-params run that
+    # never learns (lr resolved to 0, grads zeroed) is flat AT or above
+    # the predict-zero MAE (its random un-trained densities can't track
+    # GT), so require ≥10% below it (code-review r5).  Calibration: the
+    # r5 full-scale chip run at the reference's 500-epoch lr (1e-7) for
+    # 3 epochs reached 9.43 vs predict-zero 11.23 (16% better) — a
+    # tighter margin fails honest short rehearsals at untuned lr.
+    learned = min(maes) < 0.90 * res["zero_mae"]
     ok = (res["eval_rc"] == 0 and np.isfinite(res["eval_mae"])
-          and (improved or flat))
+          and learned and (improved or flat))
+    print(f"[rehearsal] best MAE {min(maes):.3f} vs predict-zero "
+          f"{res['zero_mae']:.3f} (learned bar 0.90x: "
+          f"{'pass' if learned else 'FAIL'})")
     verdict = ("executes end to end"
                + ("" if improved else " (MAE flat at floor from epoch 0)"))
     print(f"[rehearsal] {'OK' if ok else 'FAILED'} — recipe chain "
