@@ -30,3 +30,13 @@ def test_dryrun_scales_to_larger_meshes(n_devices):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "[dryrun] mesh" in proc.stdout and "ok" in proc.stdout, proc.stdout
+
+
+def test_bench_scaling_harness_executes():
+    """bench_scaling.py had no coverage and could silently rot across
+    API changes; run one real sweep point in-process on the virtual
+    mesh (finite loss asserted inside measure())."""
+    import bench_scaling
+
+    img_per_s = bench_scaling.measure(2, b=1, h=64, w=64, steps=2)
+    assert img_per_s > 0
