@@ -107,6 +107,21 @@ def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:
         raise ValueError(f"--sp {sp} does not divide device count {ndev}")
     dp = ndev // sp
     mesh = make_mesh(dp=dp, sp=sp)
+    if jax.process_count() > 1 and sp > 1:
+        # The spatial axis must stay WITHIN one host: make_global_batch
+        # feeds each host's full-height slabs, so an sp group spanning
+        # processes would make make_array_from_process_local_data stitch
+        # different hosts' images vertically into one double-height
+        # "image" and halo-exchange across the seam — silently wrong
+        # gradients (code-review r5).  Verify on the built mesh (exact
+        # regardless of create_device_mesh's ordering).
+        for row in mesh.devices:
+            if len({d.process_index for d in row}) > 1:
+                raise ValueError(
+                    f"--sp {sp} spans multiple hosts "
+                    f"({jax.local_device_count()} local devices/host); "
+                    "spatial sharding must stay within one host — lower "
+                    "--sp or use more data-parallel replicas")
     global_batch = batch_size * dp
     nproc = jax.process_count()
     if global_batch % nproc:

@@ -258,7 +258,11 @@ def main(argv=None) -> int:
         print(f"[result] images={metrics['num_images']} "
               f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
-        if args.show_index is not None:
+        if args.show_index is not None and jax.process_index() == 0:
+            # rank-0 only: every rank running this branch would (a) build
+            # the sp viz mesh from GLOBAL devices non-addressable off
+            # host 0 and crash, and (b) race identical PNG writes over
+            # shared storage (code-review r5)
             from can_tpu.data import normalize_host
 
             img, gt = ds[args.show_index]
@@ -278,7 +282,8 @@ def main(argv=None) -> int:
                 pimg[:h0] = img
                 # one image: a dp=1 x sp viz mesh (the eval mesh shards the
                 # batch dim over dp, which a single image can't fill)
-                viz_mesh = make_mesh(jax.devices()[:args.sp], dp=1,
+                # LOCAL devices: rank 0 cannot address other hosts' chips
+                viz_mesh = make_mesh(jax.local_devices()[:args.sp], dp=1,
                                      sp=args.sp)
                 fwd = make_spatial_apply(viz_mesh, (ph, w0),
                                          compute_dtype=compute_dtype)
@@ -292,8 +297,13 @@ def main(argv=None) -> int:
             else:
                 from can_tpu.cli.common import make_inference_forward
 
+                # host copies: the eval loop may have committed params to
+                # the global mesh; a rank-local jit must not consume them
+                host_params = jax.device_get(params)
+                host_stats = (jax.device_get(batch_stats)
+                              if batch_stats is not None else None)
                 et = np.asarray(make_inference_forward()(
-                    params, jnp.asarray(img)[None], batch_stats))[0]
+                    host_params, jnp.asarray(img)[None], host_stats))[0]
             paths = save_density_visualization(
                 img, gt, et, args.out_dir,
                 tag=f"{args.split}_{args.show_index}")

@@ -417,7 +417,12 @@ def main(argv=None) -> int:
                     "distinct_shapes": stats.distinct_shapes,
                 }
 
-                eval_epoch = (epoch + 1) % args.eval_interval == 0
+                # always evaluate+checkpoint the FINAL epoch too: with an
+                # interval that doesn't divide --epochs, the trailing
+                # epochs were trained but never saved — the run's last
+                # state was silently discarded at exit (code-review r5)
+                eval_epoch = ((epoch + 1) % args.eval_interval == 0
+                              or epoch == args.epochs - 1)
                 if eval_epoch:
                     metrics = evaluate(eval_step, state.params,
                                        test_batcher.epoch(0), put_fn=put,
@@ -466,7 +471,15 @@ def _save_sample_viz(args, state, test_ds, epoch, logger) -> None:
     idx = int(np.random.default_rng((args.seed, epoch)).integers(len(test_ds)))
     img, gt = test_ds[idx]
     img = normalize_host(img)  # no-op for the f32 path
-    et = _viz_forward(state.params, jnp.asarray(img)[None], state.batch_stats)
+    # This runs on rank 0 ONLY, so it must not issue a computation over
+    # the globally-committed params (unmatched multi-host computation =
+    # error or pod wedge, code-review r5): pull the replicated params to
+    # host (a local read of addressable shards) and jit over local
+    # arrays instead.
+    host_params = jax.device_get(state.params)
+    host_stats = (jax.device_get(state.batch_stats)
+                  if state.batch_stats is not None else None)
+    et = _viz_forward(host_params, jnp.asarray(img)[None], host_stats)
     out_dir = os.path.join(args.checkpoint_dir, "temp")
     paths = save_density_visualization(img, gt, np.asarray(et)[0], out_dir,
                                        tag=f"epoch{epoch}")

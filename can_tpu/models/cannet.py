@@ -153,6 +153,8 @@ def cannet_apply(
     train: bool = False,
     bn_momentum: float = 0.1,
     s2d_stem: bool = False,
+    pixel_mask: Any = None,
+    sample_mask: Any = None,
 ):
     """Forward pass: NHWC image batch -> (N, H/8, W/8, 1) density map.
 
@@ -166,6 +168,15 @@ def cannet_apply(
     the running statistics are used and only ``out`` returns.  Reductions
     over a GSPMD-sharded batch axis are global, so training-mode BN is
     cross-replica synchronized (SyncBN) with no extra code.
+
+    ``pixel_mask`` ((N, H/8, W/8, 1) validity at density-map resolution,
+    the batcher's layout) and ``sample_mask`` ((N,)) restrict train-mode
+    BN batch moments to REAL pixels of REAL images: bucket padding and
+    fill slots otherwise bias the running statistics by the padding
+    fraction of the schedule (the reference's BN never sees padding).
+    Valid regions are /8-snapped by the dataset, so the /8 mask upsampled
+    by nearest is exact at every frontend resolution.  Both default to
+    None = the original unmasked moments.
     """
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
@@ -174,7 +185,17 @@ def cannet_apply(
         raise ValueError("BN model in eval mode needs batch_stats")
     new_stats = {"frontend": [], "backend": []} if (bn and train) else None
 
-    def conv_block(x, group, i, dilation):
+    # Per-stage BN mask, tracked alongside x through the pooling ladder.
+    # Only materialised when a BN model trains with masks.
+    bn_mask = None
+    if bn and train and pixel_mask is not None:
+        m8 = pixel_mask.astype(jnp.float32)
+        if sample_mask is not None:
+            m8 = m8 * sample_mask.astype(jnp.float32)[:, None, None, None]
+        ds = x.shape[-3] // m8.shape[-3]  # 8 at input resolution
+        bn_mask = jnp.repeat(jnp.repeat(m8, ds, axis=-3), ds, axis=-2)
+
+    def conv_block(x, group, i, dilation, mask=None):
         p = params[group][i]
         if s2d_stem and group == "frontend" and i == 0:
             # space-to-depth stem (VERDICT r3 item 2): the 3-channel first
@@ -200,7 +221,8 @@ def cannet_apply(
         if bn:
             stats = None if batch_stats is None else batch_stats[group][i]
             y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum,
-                                     axes=ops.bn_axes, n_shards=ops.bn_shards)
+                                     axes=ops.bn_axes, n_shards=ops.bn_shards,
+                                     mask=mask)
             if new_stats is not None:
                 new_stats[group].append(updated)
         # checkpoint_name: identity outside jax.checkpoint; under a named
@@ -221,8 +243,12 @@ def cannet_apply(
         if v == "M":
             n_pool += 1
             x = checkpoint_name(ops.max_pool(x), f"pool{n_pool}")
+            if bn_mask is not None:
+                # stride-2 subsample tracks the pool; valid regions are
+                # /8-aligned so this is exact (no partial cells)
+                bn_mask = bn_mask[:, ::2, ::2, :]
         else:
-            x = conv_block(x, "frontend", i, 1)
+            x = conv_block(x, "frontend", i, 1, mask=bn_mask)
             i += 1
     fv = x
 
@@ -230,9 +256,9 @@ def cannet_apply(
     fi = context_block(params["context"], fv, ops=ops, precision=precision)
     x = jnp.concatenate([fv, fi], axis=-1)
 
-    # --- dilated backend ---
+    # --- dilated backend --- (at /8: bn_mask is back to pixel_mask res)
     for i in range(len(params["backend"])):
-        x = conv_block(x, "backend", i, 2)
+        x = conv_block(x, "backend", i, 2, mask=bn_mask)
     p = params["output"]
     x = ops.conv2d(
         x, p["w"].astype(x.dtype), p["b"].astype(x.dtype), padding=0, precision=precision
@@ -277,18 +303,41 @@ def context_block(cparams: Mapping, fv: jax.Array, *,
 
 
 def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
-                eps: float = 1e-5, *, axes=None, n_shards: int = 1):
+                eps: float = 1e-5, *, axes=None, n_shards: int = 1,
+                mask=None):
     """torch-semantics BatchNorm2d over NHWC: normalize with biased batch
     var in train mode, update running stats with unbiased var; f32 stats.
 
-    ``axes`` names shard_map mesh axes to ``pmean`` the batch moments over —
-    equal-sized shards make the pmean of local means the exact global mean,
+    ``axes`` names shard_map mesh axes to sync the batch moments over —
     so the sharded model IS SyncBatchNorm (the reference's
     convert_sync_batchnorm, train.py:116-118, without a wrapper module).
+
+    ``mask`` (optional, broadcastable to y[..., :1]): per-pixel validity
+    weights.  Bucket padding and dead fill slots would otherwise be
+    averaged into the batch moments — the reference's BN never sees
+    padding, so under ``--pad-multiple`` buckets the unmasked moments
+    are biased by exactly the padding fraction (code-review r5).  With a
+    mask, moments are weighted sums / weighted count, psum'd over
+    ``axes`` (also exact for UNequal per-shard valid pixels, which the
+    equal-shard pmean path can't represent).  mask=None keeps the
+    original computation bit-for-bit.
     """
     yf = y.astype(jnp.float32)
     if train:
-        if axes:
+        if mask is not None:
+            m = mask.astype(jnp.float32)  # (N, h, w, 1), matching y's NHW
+            s0 = jnp.sum(m)
+            s1 = jnp.sum(yf * m, axis=(0, 1, 2))
+            if axes:
+                s0 = jax.lax.psum(s0, axes)
+                s1 = jax.lax.psum(s1, axes)
+            mean = s1 / s0
+            ss = jnp.sum(jnp.square(yf - mean) * m, axis=(0, 1, 2))
+            if axes:
+                ss = jax.lax.psum(ss, axes)
+            var = ss / s0
+            unbiased = var * (s0 / jnp.maximum(s0 - 1.0, 1.0))
+        elif axes:
             # two-pass global moments over the mesh: mean first, then the
             # centered second moment (stabler than E[x^2] - E[x]^2)
             mean = jax.lax.pmean(jnp.mean(yf, axis=(0, 1, 2)), axes)
@@ -297,8 +346,9 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
         else:
             mean = jnp.mean(yf, axis=(0, 1, 2))
             var = jnp.var(yf, axis=(0, 1, 2))  # biased, for normalization
-        n = int(np.prod([y.shape[0], y.shape[1], y.shape[2]])) * n_shards
-        unbiased = var * (n / max(n - 1, 1))
+        if mask is None:
+            n = int(np.prod([y.shape[0], y.shape[1], y.shape[2]])) * n_shards
+            unbiased = var * (n / max(n - 1, 1))
         if stats is not None:
             updated = {
                 "mean": (1 - momentum) * stats["mean"] + momentum * mean,

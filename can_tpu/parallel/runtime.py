@@ -85,10 +85,22 @@ def init_runtime(*, coordinator_address: Optional[str] = None,
                 jax.distributed.initialize()
                 _initialized = True
             except (ValueError, RuntimeError) as e:
-                # metadata was a false positive (e.g. a tunnelled single
-                # chip) or the backend is already up — degrade to
-                # single-process like the reference (distributed_utils.py:15-18)
-                print(f"[runtime] distributed auto-init skipped: {e}")
+                if jax.process_count() > 1:
+                    # an external launcher already initialised the
+                    # distributed client for this process — use it
+                    print(f"[runtime] distributed client already up: {e}")
+                else:
+                    # Metadata NAMES a multi-host job (a single tunnelled
+                    # chip never reaches this branch — see
+                    # _multihost_metadata_present), so a failed rendezvous
+                    # must be FATAL: swallowing it left this host training
+                    # alone on a diverged lockstep schedule while its
+                    # peers waited at the coordinator — a silent
+                    # split-brain (code-review r5).
+                    raise RuntimeError(
+                        "multi-host metadata present but distributed "
+                        "rendezvous failed; refusing to degrade to "
+                        f"single-process (split-brain): {e}") from e
     return {
         "process_index": process_index(),
         "process_count": process_count(),

@@ -227,10 +227,15 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
             # collectives inside the forward are correct.)
             def fwd(params, image):
                 if has_bn:
+                    # per-shard mask slabs; _batch_norm psums the weighted
+                    # sums over the mesh axes, which is exact even for
+                    # unequal valid-pixel counts per shard
                     return cannet_apply(params, image, ops=ops,
                                         compute_dtype=compute_dtype,
                                         batch_stats=state.batch_stats,
-                                        train=True)
+                                        train=True,
+                                        pixel_mask=batch["pixel_mask"],
+                                        sample_mask=batch["sample_mask"])
                 return cannet_apply(params, image, ops=ops,
                                     compute_dtype=compute_dtype)
 

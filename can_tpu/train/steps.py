@@ -82,8 +82,13 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
             return apply_fn(params, image, compute_dtype=compute_dtype)
 
         def fwd_bn(params, image):
+            # masks keep bucket padding / fill slots out of the BN batch
+            # moments (models/cannet.py::_batch_norm; no-ops for unpadded
+            # batches where the masks are all-ones)
             return apply_fn(params, image, compute_dtype=compute_dtype,
-                            batch_stats=state.batch_stats, train=True)
+                            batch_stats=state.batch_stats, train=True,
+                            pixel_mask=batch["pixel_mask"],
+                            sample_mask=batch["sample_mask"])
 
         fwd = fwd_bn if has_bn else fwd_plain
         if remat:

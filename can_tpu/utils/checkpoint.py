@@ -22,6 +22,11 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
         import orbax.checkpoint as ocp
+        from orbax.checkpoint.checkpoint_managers import (
+            AnyPreservationPolicy,
+            BestN,
+            LatestN,
+        )
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
@@ -29,9 +34,20 @@ class CheckpointManager:
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
+                # best_fn/best_mode drive best_step() selection; RETENTION
+                # is the joint policy below.  `max_to_keep` alone with a
+                # best_fn keeps only the N best (orbax BestN semantics) —
+                # on a long run whose MAE plateaus early that silently
+                # garbage-collects every later save, so a crash-resume
+                # rolled training back hundreds of epochs (code-review
+                # r5).  Keep the N best AND always the latest.
                 best_fn=lambda m: m["mae"],
                 best_mode="min",
+                preservation_policy=AnyPreservationPolicy(policies=[
+                    BestN(get_metric_fn=lambda m: m["mae"],
+                          reverse=True, n=max_to_keep),
+                    LatestN(n=1),
+                ]),
             ),
         )
 

@@ -226,6 +226,97 @@ class TestSyncBNSpatial:
                                    rtol=4e-4)
 
 
+class TestMaskedBNMoments:
+    """Train-mode BN moments must exclude bucket padding and dead fill
+    slots (code-review r5): the reference's BN never sees padding, so the
+    unmasked moments were biased by exactly the schedule's padding
+    fraction."""
+
+    def _stats(self, params, img, pm, sm):
+        return cannet_apply(params, jnp.asarray(img),
+                            batch_stats=init_batch_stats(params), train=True,
+                            pixel_mask=jnp.asarray(pm),
+                            sample_mask=jnp.asarray(sm))[1]
+
+    def test_fill_slots_excluded_exactly(self):
+        # a dead fill slot (sample_mask 0) must not move ANY layer's
+        # stats: slot 0's activations are batch-independent, so masked
+        # stats of [img, garbage] == stats of [img] everywhere
+        params = cannet_init(jax.random.key(1), batch_norm=True)
+        rng = np.random.default_rng(3)
+        h = w = 16
+        img = rng.normal(size=(1, h, w, 3)).astype(np.float32)
+        want = self._stats(params, img, np.ones((1, 2, 2, 1), np.float32),
+                           np.ones((1,), np.float32))
+        two = np.concatenate([img, rng.normal(size=(1, h, w, 3))
+                              .astype(np.float32)])
+        got = self._stats(params, two, np.ones((2, 2, 2, 1), np.float32),
+                          np.array([1.0, 0.0], np.float32))
+        for g in ("frontend", "backend"):
+            for a, b in zip(got[g], want[g]):
+                np.testing.assert_allclose(np.asarray(a["mean"]),
+                                           np.asarray(b["mean"]),
+                                           rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(np.asarray(a["var"]),
+                                           np.asarray(b["var"]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_bucket_padding_excluded_from_moments(self):
+        # Pad H 16->24 (zeros == normalized-space padding) and compare
+        # against the unpadded run.  conv0's valid-region activations are
+        # identical (its input pad really is zero), so masked conv0 stats
+        # must match the unpadded truth EXACTLY — the direct
+        # pad-pixel-inclusion bias is gone.  Deeper layers additionally
+        # carry seam bleed (conv0's relu(bias) is nonzero in the pad
+        # region and the VGG receptive field spans the toy image), which
+        # masking cannot remove — that part is a bucketing approximation
+        # independent of BN, shared by the loss's boundary cells; masked
+        # and unmasked stats are comparable there (measured) and only
+        # conv0 admits an exact claim.
+        params = cannet_init(jax.random.key(1), batch_norm=True)
+        rng = np.random.default_rng(4)
+        h, w, ph = 16, 16, 24
+        img = rng.normal(size=(1, h, w, 3)).astype(np.float32)
+        want = self._stats(params, img, np.ones((1, 2, 2, 1), np.float32),
+                           np.ones((1,), np.float32))
+        pimg = np.zeros((1, ph, w, 3), np.float32)
+        pimg[0, :h] = img[0]
+        pm = np.zeros((1, 3, 2, 1), np.float32)
+        pm[0, :2] = 1.0
+        got = self._stats(params, pimg, pm, np.ones((1,), np.float32))
+        unmasked = cannet_apply(params, jnp.asarray(pimg),
+                                batch_stats=init_batch_stats(params),
+                                train=True)[1]
+        np.testing.assert_allclose(
+            np.asarray(got["frontend"][0]["mean"]),
+            np.asarray(want["frontend"][0]["mean"]), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(got["frontend"][0]["var"]),
+            np.asarray(want["frontend"][0]["var"]), rtol=1e-5, atol=1e-6)
+        # and the unmasked run demonstrably HAS the direct bias at conv0
+        assert not np.allclose(
+            np.asarray(unmasked["frontend"][0]["mean"]),
+            np.asarray(want["frontend"][0]["mean"]), rtol=1e-5, atol=1e-6)
+
+    def test_all_ones_mask_matches_unmasked(self):
+        params = cannet_init(jax.random.key(1), batch_norm=True)
+        rng = np.random.default_rng(5)
+        img = rng.normal(size=(2, 16, 16, 3)).astype(np.float32)
+        masked = self._stats(params, img, np.ones((2, 2, 2, 1), np.float32),
+                             np.ones((2,), np.float32))
+        plain = cannet_apply(params, jnp.asarray(img),
+                             batch_stats=init_batch_stats(params),
+                             train=True)[1]
+        for g in ("frontend", "backend"):
+            for a, b in zip(masked[g], plain[g]):
+                np.testing.assert_allclose(np.asarray(a["mean"]),
+                                           np.asarray(b["mean"]),
+                                           rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(np.asarray(a["var"]),
+                                           np.asarray(b["var"]),
+                                           rtol=1e-5, atol=1e-6)
+
+
 class TestSyncBN:
     def test_sharded_train_step_is_syncbn(self):
         """BN stats from the dp=8-sharded batch equal full-batch stats: the

@@ -55,6 +55,25 @@ class TestCheckpointManager:
         assert mgr.best_epoch() == 1
         mgr.close()
 
+    def test_latest_survives_best_retention(self, tmp_path):
+        # code-review r5: BestN-only retention deleted the LATEST save
+        # whenever its MAE wasn't top-N, so a crash-resume on a plateaued
+        # run rolled training back to an old epoch.  The joint policy
+        # must keep the newest checkpoint alongside the N best, and it
+        # must be restorable.
+        params = cannet_init(jax.random.key(0))
+        opt = make_optimizer(make_lr_schedule(1e-7))
+        state = create_train_state(params, opt)
+        mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+        for ep, mae in enumerate([50.0, 30.0, 20.0, 40.0, 60.0, 70.0]):
+            mgr.save(ep, state.replace(step=state.step + ep), mae=mae)
+        mgr.wait()
+        assert mgr.latest_epoch() == 5          # survived retention
+        assert mgr.best_epoch() == 2
+        restored = mgr.restore(state)           # latest by default
+        assert int(restored.step) == 5
+        mgr.close()
+
 
 class TestTrainCLI:
     def test_train_eval_resume(self, data_root, tmp_path):
