@@ -573,26 +573,35 @@ class ShardedBatcher:
         while True:
             over = len(programs(groups)) > self.max_buckets
             best = None  # (delta, kind, payload)
+            cap = self.max_launch_px
             if len(groups) > 1:
                 for i in range(len(groups)):
                     ki, ci, _ = groups[i]
                     for j in range(i + 1, len(groups)):
                         kj, cj, _ = groups[j]
                         join = (max(ki[0], kj[0]), max(ki[1], kj[1]))
+                        # the no-OOM promise outranks the compile budget:
+                        # never create a join cell with NO cap-fitting
+                        # launch size — _menu_for's floor fallback would
+                        # launch it above the cap (code-review r5)
+                        if cap is not None and all(
+                                s * join[0] * join[1] > cap for s in menu):
+                            continue
                         delta = (cost(join, ci + cj)
                                  - cost(ki, ci) - cost(kj, cj))
                         if (delta < 0 or over) and (
                                 best is None or delta < best[0]):
                             best = (delta, "merge", (i, j, join))
             # menu-drop lever: under a pixel cap, dropping the smallest
-            # size is only legal if every cell (full-batch AND partial)
-            # still has a fitting launch size afterwards
+            # size is only legal if every CURRENT cell — including joins
+            # created by earlier merges, whose keys are larger than any
+            # original bucket (code-review r5) — still has a fitting
+            # launch size afterwards (full-batch AND partial)
             if over and len(menu) > 1:
                 shorter = menu[:-1]
-                cap = self.max_launch_px
                 safe = cap is None or all(
-                    any(s * k[0] * k[1] <= cap for s in shorter)
-                    for k in counts)
+                    any(s * g[0][0] * g[0][1] <= cap for s in shorter)
+                    for g in groups)
                 if safe:
                     delta = total_cost(groups, shorter) - total_cost(groups)
                     if best is None or delta < best[0]:

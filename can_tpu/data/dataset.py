@@ -40,6 +40,12 @@ def _read_image_raw(path: str) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as im:
+        if im.mode in ("P", "PA", "CMYK", "YCbCr", "LAB", "HSV", "1"):
+            # palette images decode to colormap INDICES, not intensities —
+            # np.asarray on mode 'P' would feed meaningless pixels through
+            # the grayscale branch below (code-review r5); exotic color
+            # spaces likewise need a real conversion
+            im = im.convert("RGB")
         arr = np.asarray(im)
     if arr.ndim == 2:  # grayscale -> 3 channels (reference :41-43)
         arr = np.stack([arr] * 3, axis=-1)
@@ -113,14 +119,36 @@ class CrowdDataset:
             f for f in os.listdir(img_root)
             if os.path.isfile(os.path.join(img_root, f))
         )
+        # Reject sub-gt_downsample images at LISTING time: an image
+        # shorter/narrower than one density cell snaps to a 0 extent,
+        # which the batcher would bucket and cv2.resize would then crash
+        # on mid-epoch deep in a loader thread (code-review r5).  The
+        # header reads are cached — the bucketing batcher asks for every
+        # snapped shape anyway, so this costs one pass, not two.
+        self._snapped_cache: Optional[list] = None
+        if self.gt_downsample > 1:
+            shapes = [self._snapped_shape_uncached(i)
+                      for i in range(len(self.img_names))]
+            for f, (h, w) in zip(self.img_names, shapes):
+                if h == 0 or w == 0:
+                    raise ValueError(
+                        f"image {os.path.join(img_root, f)} is smaller than "
+                        f"one {self.gt_downsample}px density cell "
+                        f"(snapped shape {h}x{w}); remove or upscale it")
+            self._snapped_cache = shapes
 
     def __len__(self) -> int:
         return len(self.img_names)
 
     def snapped_shape(self, index: int) -> Tuple[int, int]:
-        """(H, W) the item will have after /8 snapping — cheap (header-only
-        read), used by the bucketing batcher to group shapes without decoding
-        full images."""
+        """(H, W) the item will have after /8 snapping — header-only read,
+        cached at listing time; used by the bucketing batcher to group
+        shapes without decoding full images."""
+        if self._snapped_cache is not None:
+            return self._snapped_cache[index]
+        return self._snapped_shape_uncached(index)
+
+    def _snapped_shape_uncached(self, index: int) -> Tuple[int, int]:
         from PIL import Image
 
         with Image.open(os.path.join(self.img_root, self.img_names[index])) as im:

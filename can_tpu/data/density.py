@@ -227,16 +227,27 @@ def generate_density_maps(image_dirs: Sequence[str], *, k: int = 3,
     written = 0
     for path in image_dirs:
         for img_path in sorted(glob.glob(os.path.join(path, "*.jpg"))):
-            mat_path = (img_path.replace(".jpg", ".mat")
-                        .replace("images", "ground_truth")
-                        .replace("IMG_", "GT_IMG_"))
+            # Component-wise path construction: blanket str.replace over
+            # the ABSOLUTE path rewrote any parent directory containing
+            # 'images'/'IMG_'/'.jpg' as a substring, silently reading or
+            # writing in unrelated trees (code-review r5).  Only the
+            # leaf directory named 'images' and the file's own basename
+            # are transformed (reference k_nearest_gaussian_kernel.py:
+            # 76-83 scheme).
+            img_dir, fname = os.path.split(img_path)
+            parent, leaf = os.path.split(img_dir)
+            gt_dir = (os.path.join(parent, "ground_truth")
+                      if leaf == "images" else img_dir)
+            stem = os.path.splitext(fname)[0]
+            mat_path = os.path.join(
+                gt_dir, ("GT_" + stem if stem.startswith("IMG_") else stem)
+                + ".mat")
             with Image.open(img_path) as im:
                 w, h = im.size
             points = _load_mat_points(mat_path)
             dmap = gaussian_density_map(points, (h, w), k=k,
                                         sigma_scale=sigma_scale)
-            out = (img_path.replace(".jpg", ".npy")
-                   .replace("images", "ground_truth"))
+            out = os.path.join(gt_dir, stem + ".npy")
             np.save(out, dmap)
             written += 1
             if verbose:

@@ -49,6 +49,14 @@ class MetricLogger:
                 self._wandb = wandb
             except ImportError:
                 print("[logging] wandb not installed; falling back to stdout")
+            except Exception as e:
+                # runtime init failures too (no network, bad/absent
+                # credentials — wandb raises CommError/UsageError, not
+                # ImportError): the module contract is that logging
+                # degrades to stdout and the run keeps going
+                # (code-review r5)
+                print(f"[logging] wandb.init failed ({type(e).__name__}: "
+                      f"{e}); falling back to stdout")
 
     def log(self, metrics: dict, *, step: Optional[int] = None) -> None:
         if not self.enabled:

@@ -44,6 +44,14 @@ def device_watchdog(seconds: float = 300.0, on_timeout=None):
 
     def boom():
         if not armed.wait(seconds):
+            # Re-check after the wait: jax.devices() may have returned
+            # just before the deadline with armed.set() not yet executed
+            # — killing a healthy process with a false "unreachable"
+            # artifact (code-review r5).  One grace second closes the
+            # set-vs-timeout race; a genuinely hung backend cannot set
+            # the event at all.
+            if armed.wait(1.0):
+                return
             import sys
 
             if on_timeout is not None:

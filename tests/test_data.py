@@ -293,6 +293,33 @@ class TestPrefetch:
 
         assert list(prefetch_to_device([], lambda x: x)) == []
 
+    def test_abandonment_cancels_queued_loads(self):
+        # code-review r5: abandoning the generator (NonFiniteLossError,
+        # Ctrl-C, early break) must CANCEL queued loads, not block close
+        # behind `depth` more host->device transfers (forever, on a
+        # wedged tunnel).  With depth=4 and one consumed batch, at most
+        # the yielded + one in-flight load may have started; the rest
+        # must never run.
+        import time
+
+        from can_tpu.data import prefetch_to_device
+
+        started = []
+
+        def put(x):
+            started.append(x)
+            time.sleep(0.05)
+            return x
+
+        gen = prefetch_to_device(range(50), put, depth=4)
+        next(gen)
+        t0 = time.perf_counter()
+        gen.close()
+        close_s = time.perf_counter() - t0
+        time.sleep(0.2)  # let any (wrongly) surviving queued loads run
+        assert len(started) <= 6, started  # depth+1 starts before close
+        assert close_s < 1.0  # not 50 x 0.05s of remaining loads
+
 
 class TestNativeStamping:
     def test_native_matches_numpy(self):
@@ -352,6 +379,33 @@ class TestMatPipeline:
         assert d.shape == (h, w)
         # interior points: count conserved
         assert abs(d.sum() - 12) < 0.1
+
+    def test_paths_with_hostile_parent_names(self, tmp_path):
+        # code-review r5: blanket str.replace rewrote PARENT directories
+        # containing 'images'/'IMG_' as substrings, reading or writing in
+        # unrelated trees.  Only the leaf 'images' dir and the basename
+        # may be transformed.
+        import scipy.io as sio
+        from PIL import Image
+
+        from can_tpu.data import generate_density_maps
+
+        root = tmp_path / "crowd_images" / "IMG_files" / "train_data"
+        (root / "images").mkdir(parents=True)
+        (root / "ground_truth").mkdir()
+        rng = np.random.default_rng(1)
+        h, w = 64, 72
+        Image.fromarray((rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8)
+                        ).save(root / "images" / "IMG_3.jpg")
+        pts = np.stack([rng.uniform(10, w - 10, 5),
+                        rng.uniform(10, h - 10, 5)], axis=1)
+        inner = np.empty((1, 1), object)
+        inner[0, 0] = (pts,)
+        sio.savemat(root / "ground_truth" / "GT_IMG_3.mat",
+                    {"image_info": inner})
+        assert generate_density_maps([str(root / "images")],
+                                     verbose=False) == 1
+        assert (root / "ground_truth" / "IMG_3.npy").exists()
 
 
 class TestWorkerLoading:
@@ -765,6 +819,27 @@ class TestRemnantSubBatches:
         assert any(k[0] * k[1] * len(g) > cap
                    for k, g in unc.global_schedule(1))
 
+    def test_merged_join_cells_respect_pixel_cap(self):
+        # code-review r5: the drop lever's safety check covered only the
+        # ORIGINAL bucket keys, and a drop-then-merge order could create
+        # a join cell (elementwise-max shape, larger than any original)
+        # whose only cap-fitting launch size had just been dropped —
+        # _menu_for's floor fallback then launched it ABOVE the cap the
+        # planner promised.  Now merges refuse to create cap-unfittable
+        # joins and drop safety checks the CURRENT group keys.  This test
+        # pins the invariant on the merge-forced path (max_buckets=1,
+        # join fits only at the smallest size); the merge-heavy fuzz
+        # trials below stress the lever orderings.
+        sizes = [(128, 32)] * 16 + [(32, 128)] * 16
+        cap = 4 * 128 * 128  # join (128,128) fits only at size 4
+        b = self._mk(sizes, bs=16, batch_quantum=4, max_buckets=1,
+                     launch_cost_px=2e6, max_launch_px=cap)
+        seen = []
+        for key, group in b.global_schedule(0):
+            assert key[0] * key[1] * len(group) <= cap, (key, len(group))
+            seen += [i for i, v in group if v]
+        assert sorted(seen) == list(range(32))
+
     def test_never_worse_than_legacy_padding(self):
         # when full-batch shapes saturate max_buckets (large datasets), the
         # planner must fall back to the legacy merge+pad path rather than
@@ -801,18 +876,21 @@ class TestRemnantSubBatches:
         host lockstep, and never more scheduled pixels than the legacy
         pad-to-gbs path."""
         rng = np.random.default_rng(123)
-        for trial in range(12):
+        for trial in range(20):
+            merge_heavy = trial >= 12  # stress merge/drop lever orderings
             n = int(rng.integers(5, 90))
-            shapes = [((int(rng.integers(4, 17)) * 8),
-                       (int(rng.integers(4, 17)) * 8)) for _ in range(n)]
+            hi = 34 if merge_heavy else 17
+            shapes = [((int(rng.integers(4, hi)) * 8),
+                       (int(rng.integers(4, hi)) * 8)) for _ in range(n)]
             per_host = int(rng.choice([2, 4, 8]))
             hosts = int(rng.choice([1, 2]))
             quantum = hosts * int(rng.choice([1, 2]))
             if (per_host * hosts) % quantum:
                 quantum = hosts
-            mb = int(rng.choice([4, 8, 24]))
+            mb = int(rng.choice([1, 2, 4] if merge_heavy else [4, 8, 24]))
             lc = float(rng.choice([0.0, 2e5, 2e6]))
-            cap = float(rng.choice([0, 10e6]))  # 0 = uncapped
+            cap = float(rng.choice([1e5, 3e6] if merge_heavy
+                                   else [0, 10e6]))  # 0 = uncapped
             kw = dict(shuffle=True, seed=7, pad_multiple="auto",
                       max_buckets=mb, remnant_sizes=True,
                       batch_quantum=quantum, launch_cost_px=lc,
