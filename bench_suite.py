@@ -138,8 +138,12 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     Two throughputs are reported:
 
     * ``value`` — steady-state img/s over the epoch's PRE-STAGED device
-      batches (bucket-shape switching, donation, metric fetches included;
-      host->device transfer excluded).  On real TPU hosts PCIe (tens of
+      batches (bucket-shape switching and donation included; host->device
+      transfer excluded, and steps are dispatched back-to-back with ONE
+      terminal fetch — the train loop's windowed metric fetch every
+      check_every=8 steps is NOT in this number, so on dispatch-bound
+      tunnels the loop achieves somewhat less; the end_to_end entry
+      carries that cost).  On real TPU hosts PCIe (tens of
       GB/s) overlapped by prefetch keeps the end-to-end rate at this
       number, so this is the capability figure.
     * ``end_to_end_img_per_s`` — the same epoch through ``train_one_epoch``
@@ -306,10 +310,13 @@ def bench_host_pipeline(*, n_images, batch, h=576, w=768, workers=(0, 4, 8),
             for wk in workers:
                 batcher = ShardedBatcher(ds, batch, shuffle=True, seed=0,
                                          pad_multiple="auto", num_workers=wk)
-                list(batcher.epoch(0))  # warm the fs cache / thread pool
-                t0 = time.perf_counter()
-                n_done = sum(b.num_valid for b in batcher.epoch(1))
-                dt = time.perf_counter() - t0
+                try:
+                    list(batcher.epoch(0))  # warm fs cache / thread pool
+                    t0 = time.perf_counter()
+                    n_done = sum(b.num_valid for b in batcher.epoch(1))
+                    dt = time.perf_counter() - t0
+                finally:
+                    batcher.close()  # 6 abandoned pools leaked threads
                 tag = "_u8" if u8 else ""
                 _emit(f"host_pipeline_{h}x{w}_b{batch}_w{wk}{tag}",
                       n_done / dt, "images/sec", workers=wk,

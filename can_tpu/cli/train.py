@@ -139,7 +139,9 @@ def parse_args(argv=None):
                         "'off' disables")
     p.add_argument("--vgg16-npz", type=str, default="",
                    help="pretrained VGG-16 frontend .npz (tools/convert_vgg16.py)")
-    p.add_argument("--eval-interval", type=int, default=1)
+    p.add_argument("--eval-interval", type=int, default=1,
+                   help="evaluate+checkpoint every N epochs (>= 1; the "
+                        "final epoch always evaluates)")
     p.add_argument("--profile-dir", type=str, default="")
     p.add_argument("--max-steps-per-epoch", type=int, default=0,
                    help="truncate epochs (smoke tests); 0 = full epoch")
@@ -217,6 +219,13 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     # pure arg/path validation BEFORE any runtime init: a typo'd path must
     # not cost a multi-host rendezvous
+    if args.eval_interval < 1:
+        # 0 conventionally means 'off' elsewhere, but here it would
+        # ZeroDivisionError only AFTER a full epoch trained with nothing
+        # checkpointed (code-review r5) — reject before any work
+        raise SystemExit("--eval-interval must be >= 1 (the final epoch "
+                         "always evaluates; large values approximate "
+                         "'rarely')")
     train_img, train_gt = resolve_split_roots(
         "train", args.train_image_root, args.train_gt_root, args.data_root)
     test_img, test_gt = resolve_split_roots(
@@ -344,16 +353,31 @@ def main(argv=None) -> int:
 
     ckpt = CheckpointManager(args.checkpoint_dir)
     start_epoch = 0
+    resumed_best = None
     if args.init_checkpoint:
         resume = CheckpointManager(args.init_checkpoint)
-        latest = resume.latest_epoch()
-        if latest is not None:
-            state = resume.restore(state)
-            start_epoch = latest + 1
-            if main_proc:
-                print(f"[resume] epoch {latest} from {args.init_checkpoint}")
-        elif main_proc:
-            print(f"[resume] no checkpoint in {args.init_checkpoint}; cold start")
+        try:
+            latest = resume.latest_epoch()
+            if latest is not None:
+                state = resume.restore(state)
+                start_epoch = latest + 1
+                # carry the prior leg's best forward so [best]/[done]
+                # report the RUN's best, not the resumed leg's
+                # (code-review r5)
+                resumed_best = resume.best_metric()
+                if main_proc:
+                    print(f"[resume] epoch {latest} from "
+                          f"{args.init_checkpoint}"
+                          + (f" (best so far {resumed_best:.3f})"
+                             if resumed_best is not None else ""))
+            elif main_proc:
+                print(f"[resume] no checkpoint in {args.init_checkpoint}; "
+                      "cold start")
+        finally:
+            # the restore manager must not stay alive for the whole run —
+            # its stale step/metrics view aliases ckpt's directory on an
+            # in-place resume (code-review r5)
+            resume.close()
 
     apply_fn = cannet_apply
     if args.s2d_stem:
@@ -393,7 +417,7 @@ def main(argv=None) -> int:
                           config=vars(args),
                           run_id_file=os.path.join(args.checkpoint_dir,
                                                    "wandb_run_id.txt"))
-    best_mae = float("inf")
+    best_mae = float("inf") if resumed_best is None else float(resumed_best)
     try:
         with profile_trace(args.profile_dir or None):
             for epoch in range(start_epoch, args.epochs):
@@ -483,7 +507,7 @@ def _save_sample_viz(args, state, test_ds, epoch, logger) -> None:
     out_dir = os.path.join(args.checkpoint_dir, "temp")
     paths = save_density_visualization(img, gt, np.asarray(et)[0], out_dir,
                                        tag=f"epoch{epoch}")
-    logger.log_images(paths, caption=f"epoch {epoch}")
+    logger.log_images(paths, caption=f"epoch {epoch}", step=epoch)
 
 
 if __name__ == "__main__":

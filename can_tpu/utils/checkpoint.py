@@ -77,6 +77,19 @@ class CheckpointManager:
     def best_epoch(self) -> Optional[int]:
         return self.manager.best_step()
 
+    def best_metric(self) -> Optional[float]:
+        """Best-epoch MAE from the saved metrics, or None — so a resumed
+        run can carry the prior leg's best forward instead of resetting
+        its '[best]' reporting to inf (code-review r5)."""
+        step = self.manager.best_step()
+        if step is None:
+            return None
+        try:
+            metrics = self.manager.metrics(step)
+            return float(metrics["mae"]) if metrics else None
+        except Exception:
+            return None
+
     def wait(self) -> None:
         self.manager.wait_until_finished()
 

@@ -67,10 +67,16 @@ class MetricLogger:
         if self._wandb is not None:
             self._wandb.log(metrics, step=step)
 
-    def log_images(self, paths: list, *, caption: str = "") -> None:
+    def log_images(self, paths: list, *, caption: str = "",
+                   step: Optional[int] = None) -> None:
+        # step must ride along: a step-less wandb.log auto-increments and
+        # commits the current row, attributing these images to the NEXT
+        # epoch's metrics row and dropping later same-step logs
+        # (code-review r5)
         if self.enabled and self._wandb is not None:
             self._wandb.log({
-                caption or "images": [self._wandb.Image(p) for p in paths]})
+                caption or "images": [self._wandb.Image(p) for p in paths]},
+                step=step)
 
     def finish(self) -> None:
         if self._wandb is not None:

@@ -70,9 +70,16 @@ class TestCheckpointManager:
         mgr.wait()
         assert mgr.latest_epoch() == 5          # survived retention
         assert mgr.best_epoch() == 2
+        assert mgr.best_metric() == 20.0        # resume carries this forward
         restored = mgr.restore(state)           # latest by default
         assert int(restored.step) == 5
         mgr.close()
+
+    def test_eval_interval_validated_at_parse_time(self):
+        from can_tpu.cli.train import main
+
+        with pytest.raises(SystemExit, match="eval-interval"):
+            main(["--data_root", "/nonexistent", "--eval-interval", "0"])
 
 
 class TestTrainCLI:
