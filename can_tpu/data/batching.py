@@ -607,6 +607,18 @@ class ShardedBatcher:
                     if best is None or delta < best[0]:
                         best = (delta, "drop", shorter)
             if best is None or (best[0] >= 0 and not over):
+                if over and "budget" not in self._cap_warned:
+                    # the pixel cap outranks the compile budget, so a
+                    # plan can now finish ABOVE max_buckets when every
+                    # remaining merge would create a cap-unfittable join
+                    # — say so instead of silently blowing the budget
+                    # (code-review r5)
+                    self._cap_warned.add("budget")
+                    print(f"[batching] WARNING: "
+                          f"{len(programs(groups))} programs exceed "
+                          f"max_buckets={self.max_buckets} — the "
+                          f"per-launch pixel cap prevents further "
+                          f"merging; expect extra XLA compiles")
                 break
             if best[1] == "drop":
                 menu = best[2]

@@ -40,11 +40,12 @@ def _read_image_raw(path: str) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as im:
-        if im.mode in ("P", "PA", "CMYK", "YCbCr", "LAB", "HSV", "1"):
-            # palette images decode to colormap INDICES, not intensities —
-            # np.asarray on mode 'P' would feed meaningless pixels through
-            # the grayscale branch below (code-review r5); exotic color
-            # spaces likewise need a real conversion
+        if im.mode not in ("RGB", "RGBA", "L"):
+            # ALLOWLIST, not a blocklist of known-bad modes: palette ('P')
+            # decodes to colormap indices, 'LA' to 2-channel arrays that
+            # dodge both branches below, 'I' to int32 that mis-normalises
+            # — every non-RGB/L mode needs a real conversion
+            # (code-review r5)
             im = im.convert("RGB")
         arr = np.asarray(im)
     if arr.ndim == 2:  # grayscale -> 3 channels (reference :41-43)

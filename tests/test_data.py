@@ -316,9 +316,14 @@ class TestPrefetch:
         t0 = time.perf_counter()
         gen.close()
         close_s = time.perf_counter() - t0
-        time.sleep(0.2)  # let any (wrongly) surviving queued loads run
-        assert len(started) <= 6, started  # depth+1 starts before close
-        assert close_s < 1.0  # not 50 x 0.05s of remaining loads
+        time.sleep(0.3)  # let any (wrongly) surviving queued loads run
+        # The OLD `with ThreadPoolExecutor` code started all 5 submitted
+        # loads and close() waited ~4 x 0.05s for them — both asserts
+        # below fail on it (verified).  Post-fix: the yielded load, the
+        # one in-flight, and at most one more that slips in before
+        # cancellation.
+        assert len(started) <= 3, started
+        assert close_s < 0.15, close_s
 
 
 class TestNativeStamping:
