@@ -94,6 +94,30 @@ class TestCrowdDataset:
         un = img * IMAGENET_STD + IMAGENET_MEAN
         assert un.min() > -0.02 and un.max() < 1.02
 
+    def test_exotic_image_modes_convert_to_rgb(self, tmp_path):
+        # code-review r5: palette ('P') decoded to colormap indices, 'LA'
+        # to 2-channel arrays that dodged both normalisation branches,
+        # 'I' to int32 that mis-scaled — every non-RGB/L mode must be
+        # converted, not fed through raw
+        from PIL import Image
+
+        from can_tpu.data.dataset import _read_image, _read_image_u8
+
+        rng = np.random.default_rng(0)
+        rgb = (rng.uniform(0, 1, (16, 24, 3)) * 255).astype(np.uint8)
+        for mode, ext in (("P", "png"), ("LA", "png"), ("I", "tiff"),
+                          ("CMYK", "tiff"), ("1", "png")):
+            p = tmp_path / f"m_{mode}.{ext}"
+            Image.fromarray(rgb).convert(mode).save(p)
+            arr = _read_image(str(p))
+            assert arr.shape == (16, 24, 3) and arr.dtype == np.float32
+            assert 0.0 <= arr.min() and arr.max() <= 1.0
+            # mode 'I' used to normalise by int32 max -> near-black
+            if mode == "I":
+                assert arr.max() > 0.2, arr.max()
+            u8 = _read_image_u8(str(p))
+            assert u8.shape == (16, 24, 3) and u8.dtype == np.uint8
+
     def test_snapped_shape_matches_item(self, synth):
         ds = CrowdDataset(synth[0], synth[1], gt_downsample=8, phase="test")
         for i in range(len(ds)):
