@@ -23,6 +23,13 @@ scale), bf16 compute / f32 params, full train step (fwd + bwd + SGD update),
 steady state over 20 steps after 3 warmup steps.  Override via env:
 BENCH_BATCH, BENCH_H, BENCH_W, BENCH_STEPS, BENCH_F32=1.
 
+BENCH_TELEMETRY_DIR=<dir>: additionally record compile / step_window /
+memory / bench events to <dir>/telemetry.host0.jsonl — the SAME schema the
+train CLI writes, so BENCH artifacts and training runs are directly
+comparable (tools/telemetry_report.py reads both).  Unset (the driver's
+configuration), the hot loop is byte-identical to before — telemetry costs
+nothing when off.
+
 Measured history (one v5e chip, 576x768): bf16 b4 41.8 -> b8 85.5 ->
 b16 92.7 img/s (b32 88.7; the batch=1-per-device reference habit leaves
 half the chip idle); full-f32 b16 61.8 img/s.
@@ -96,6 +103,21 @@ def main() -> None:
     step = make_dp_train_step(apply_fn, opt, mesh,
                               compute_dtype=compute_dtype)
 
+    tel = None
+    raw_step = step
+    if os.environ.get("BENCH_TELEMETRY_DIR"):
+        from can_tpu import obs
+
+        tel = obs.open_host_telemetry(os.environ["BENCH_TELEMETRY_DIR"])
+        tel.emit("run", config={"metric": metric, "batch": b, "h": h,
+                                "w": w, "steps": steps, "f32": f32,
+                                "devices": ndev})
+        # first call per signature = the compile bill, attributed.  The
+        # wrapper covers only WARMUP (where the compile happens); the
+        # timed loop below runs the raw step so the measured number is
+        # the same with telemetry on or off.
+        step = obs.RecompileTracker(step, tel, name="bench_step")
+
     # fence with an actual D2H fetch: over the axon tunnel
     # block_until_ready() returns immediately, only materialising a value
     # truly waits for the chained device work
@@ -103,6 +125,7 @@ def main() -> None:
         state, metrics = step(state, gbatch)
     float(jax.device_get(metrics["loss"]))
 
+    step = raw_step  # timed loop bypasses any telemetry wrapper
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, gbatch)
@@ -112,13 +135,25 @@ def main() -> None:
 
     img_per_s = local_b * steps / dt
     per_chip = img_per_s / ndev
-    print(json.dumps({
+    record = {
         "metric": metric,
         "value": round(img_per_s, 3),
         "unit": "images/sec",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_S_H100, 3),
         "baseline_estimate": BASELINE_IMG_PER_S_H100,
-    }))
+    }
+    if tel is not None:
+        # the steady-state window as ONE step_window event (the timed loop
+        # itself stays uninstrumented — no per-step host work in the
+        # measurement), plus a memory snapshot and the result record
+        tel.emit("step_window", phase="bench", steps=steps,
+                 seconds=round(dt, 4), images=local_b * steps,
+                 samples_s=[], mean_step_s=round(dt / steps, 6),
+                 img_per_s=round(img_per_s, 3))
+        obs.emit_memory(tel, where="bench_steady_state")
+        tel.emit("bench", **record)
+        tel.close()
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

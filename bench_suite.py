@@ -37,6 +37,13 @@ import numpy as np
 
 from bench import BASELINE_IMG_PER_S_H100 as BASELINE_EST
 
+# BENCH_TELEMETRY_DIR wiring (see bench.py): every record is ALSO emitted
+# as a ``bench`` event, and the pipeline configs run their epochs with the
+# telemetry bus attached — so suite artifacts carry the same compile /
+# step_window / stall / memory stream a training run does.  None when the
+# env var is unset: zero cost.
+_TELEMETRY = None
+
 
 def _emit(metric: str, value: float, unit: str, *, per_chip: float = None,
           **extra) -> None:
@@ -45,6 +52,8 @@ def _emit(metric: str, value: float, unit: str, *, per_chip: float = None,
         rec["vs_baseline"] = round(per_chip / BASELINE_EST, 3)
         rec["baseline_estimate"] = BASELINE_EST
     rec.update(extra)
+    if _TELEMETRY is not None:
+        _TELEMETRY.emit("bench", **rec)
     print(json.dumps(rec), flush=True)
 
 
@@ -201,15 +210,20 @@ def bench_pipeline(jnp, compute_dtype, *, n_images, batch, epochs,
     step = make_step()
 
     # epoch 0 end-to-end: pays every bucket-shape compile (near zero on a
-    # second fresh process once the persistent cache is populated)
+    # second fresh process once the persistent cache is populated).  With
+    # BENCH_TELEMETRY_DIR the epochs run with the bus attached: per-shape
+    # compile events, step windows, and stall accounting land in the same
+    # JSONL schema a training run writes.
     t0 = time.perf_counter()
     state, s0 = train_one_epoch(step, state, batcher.epoch(0), put_fn=put,
-                                epoch=0, show_progress=False)
+                                epoch=0, show_progress=False,
+                                telemetry=_TELEMETRY)
     compile_epoch_s = time.perf_counter() - t0
 
     # steady-state end-to-end (transfers + prefetch overlap included)
     state, s1 = train_one_epoch(step, state, batcher.epoch(1), put_fn=put,
-                                epoch=1, show_progress=False)
+                                epoch=1, show_progress=False,
+                                telemetry=_TELEMETRY)
 
     # warm restart: drop the in-memory executables (what a fresh process
     # starts without) but keep the on-disk cache — the epoch now measures
@@ -431,6 +445,16 @@ def main() -> None:
     print(f"# bench_suite devices={jax.device_count()} "
           f"platform={jax.devices()[0].platform} quick={quick}", flush=True)
 
+    global _TELEMETRY
+    if os.environ.get("BENCH_TELEMETRY_DIR"):
+        from can_tpu import obs
+
+        _TELEMETRY = obs.open_host_telemetry(
+            os.environ["BENCH_TELEMETRY_DIR"])
+        _TELEMETRY.emit("run", config={"suite": True, "quick": quick,
+                                       "only": only,
+                                       "devices": jax.device_count()})
+
     def want(name: str) -> bool:
         return only in name
 
@@ -473,6 +497,13 @@ def main() -> None:
                                 lo=384, hi=768, dominant=(576, 768))
         if want("host"):
             bench_host_pipeline(n_images=48, batch=8, workers=(0, 4, 8))
+
+    if _TELEMETRY is not None:
+        from can_tpu.obs import emit_memory
+
+        emit_memory(_TELEMETRY, where="suite_end")
+        _TELEMETRY.close()
+        _TELEMETRY = None
 
 
 if __name__ == "__main__":

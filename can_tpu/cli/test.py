@@ -89,6 +89,16 @@ def parse_args(argv=None):
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables)")
+    p.add_argument("--profile-dir", type=str, default="",
+                   help="jax.profiler trace output dir (with --trace-steps)")
+    p.add_argument("--trace-steps", type=str, default="",
+                   help="trace WINDOW by eval-batch range, START:STOP "
+                        "slice semantics, into --profile-dir")
+    p.add_argument("--telemetry-dir", type=str, default="",
+                   help="write structured telemetry JSONL here (same "
+                        "schema as the train CLI; one file per host)")
+    p.add_argument("--telemetry-heartbeat-s", type=float, default=60.0,
+                   help="heartbeat event interval (with --telemetry-dir)")
     p.add_argument("--max-buckets", type=int, default=24,
                    help="compile budget for --pad-multiple auto (distinct "
                         "(shape x batch-size) programs)")
@@ -163,12 +173,19 @@ def main(argv=None) -> int:
     from can_tpu.cli.train import (
         apply_compile_cache,
         apply_platform,
+        build_telemetry,
         resolve_num_workers,
+        validate_trace_args,
     )
 
+    trace_window = validate_trace_args(args)
     apply_platform(args)
     init_runtime()
     apply_compile_cache(args)
+    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
+                                           trace_window=trace_window)
+    # loop instrumentation only when something consumes it (see train CLI)
+    loop_tel = telemetry if (args.telemetry_dir or trace_window) else None
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -252,9 +269,12 @@ def main(argv=None) -> int:
                                put_fn=lambda b: make_global_batch(
                                    b, mesh, spatial=args.sp > 1),
                                dataset_size=batcher.dataset_size,
-                               show_progress=True, batch_stats=batch_stats)
+                               show_progress=True, batch_stats=batch_stats,
+                               telemetry=loop_tel)
         finally:
             batcher.close()
+        telemetry.emit("epoch", step=0, phase="eval", mae=metrics["mae"],
+                       mse=metrics["mse"], num_images=metrics["num_images"])
         print(f"[result] images={metrics['num_images']} "
               f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 
@@ -310,6 +330,9 @@ def main(argv=None) -> int:
             print(f"[viz] wrote {paths}")
         return 0
     finally:
+        if heartbeat is not None:
+            heartbeat.close()
+        telemetry.close()
         shutdown_runtime()  # the reference leaks its process group (SURVEY §3.1)
 
 

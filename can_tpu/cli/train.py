@@ -143,6 +143,20 @@ def parse_args(argv=None):
                    help="evaluate+checkpoint every N epochs (>= 1; the "
                         "final epoch always evaluates)")
     p.add_argument("--profile-dir", type=str, default="")
+    p.add_argument("--trace-steps", type=str, default="",
+                   help="jax.profiler trace WINDOW by run-local step range, "
+                        "START:STOP slice semantics (e.g. 10:13 = steps "
+                        "10..12) into --profile-dir — instead of the "
+                        "whole-run trace a bare --profile-dir captures")
+    p.add_argument("--telemetry-dir", type=str, default="",
+                   help="write structured telemetry JSONL here (one "
+                        "telemetry.host{k}.jsonl per host: compile / "
+                        "step_window / stall / memory / heartbeat / epoch "
+                        "events; summarize with tools/telemetry_report.py)")
+    p.add_argument("--telemetry-heartbeat-s", type=float, default=60.0,
+                   help="heartbeat event interval (with --telemetry-dir): "
+                        "a hung run leaves a last-known-good timestamp; "
+                        "<= 0 disables the heartbeat thread")
     p.add_argument("--max-steps-per-epoch", type=int, default=0,
                    help="truncate epochs (smoke tests); 0 = full epoch")
     p.add_argument("--platform", type=str, default="default",
@@ -200,6 +214,44 @@ def apply_platform(args) -> None:
         jax.config.update("jax_platforms", args.platform)
 
 
+def validate_trace_args(args):
+    """Parse ``--trace-steps`` (SystemExit on malformed specs, BEFORE any
+    runtime init) and require the trace destination."""
+    from can_tpu.obs import parse_trace_steps
+
+    try:
+        window = parse_trace_steps(getattr(args, "trace_steps", ""))
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if window and not args.profile_dir:
+        raise SystemExit("--trace-steps needs --profile-dir (the trace's "
+                         "output directory)")
+    return window
+
+
+def build_telemetry(args, *, host_id: int, trace_window, logger=None):
+    """The CLIs' shared wiring: per-host JSONL sink (``--telemetry-dir``),
+    MetricLogger adapter (epoch scalars keep flowing to stdout/wandb
+    unchanged), optional step-range trace window, heartbeat thread.
+    Returns ``(telemetry, heartbeat_or_None)``."""
+    from can_tpu import obs
+
+    trace = (obs.StepTraceWindow(args.profile_dir, *trace_window)
+             if trace_window else None)
+    extra = [obs.MetricLoggerSink(logger)] if logger is not None else []
+    if args.telemetry_dir:
+        tel = obs.open_host_telemetry(args.telemetry_dir, host_id=host_id,
+                                      extra_sinks=extra, trace=trace)
+    else:
+        tel = obs.Telemetry(extra, host_id=host_id, trace=trace)
+    tel.emit("run", config={k: v for k, v in vars(args).items()
+                            if isinstance(v, (str, int, float, bool,
+                                              type(None)))})
+    hb = (obs.Heartbeat(tel, args.telemetry_heartbeat_s)
+          if args.telemetry_dir else None)
+    return tel, hb
+
+
 def apply_compile_cache(args, *, announce: bool = False) -> None:
     from can_tpu.utils import enable_compilation_cache
 
@@ -244,6 +296,7 @@ def main(argv=None) -> int:
                              "the warm-started params; pick one")
         if not os.path.isfile(args.init_torch_pth):
             raise SystemExit(f"no such checkpoint file: {args.init_torch_pth}")
+    trace_window = validate_trace_args(args)
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
@@ -417,9 +470,22 @@ def main(argv=None) -> int:
                           config=vars(args),
                           run_id_file=os.path.join(args.checkpoint_dir,
                                                    "wandb_run_id.txt"))
+    # telemetry: per-host JSONL (+ MetricLogger adapter, so epoch scalars
+    # reach stdout/wandb exactly as before), heartbeat thread, and the
+    # step-range trace trigger.  With --trace-steps the whole-run
+    # profile_trace below is disarmed — the window replaces it.
+    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
+                                           trace_window=trace_window,
+                                           logger=logger)
+    # the LOOPS are instrumented only when something consumes per-step
+    # data (JSONL sink or a trace window): the default run's hot path
+    # must stay byte-identical — the bus still carries the once-per-epoch
+    # metrics row to the MetricLogger either way
+    loop_tel = telemetry if (args.telemetry_dir or trace_window) else None
     best_mae = float("inf") if resumed_best is None else float(resumed_best)
     try:
-        with profile_trace(args.profile_dir or None):
+        with profile_trace(None if trace_window
+                           else (args.profile_dir or None)):
             for epoch in range(start_epoch, args.epochs):
                 batches = train_batcher.epoch(epoch)
                 if args.max_steps_per_epoch:
@@ -429,7 +495,7 @@ def main(argv=None) -> int:
                 state, stats = train_one_epoch(
                     train_step, state, batches, put_fn=put, epoch=epoch,
                     show_progress=main_proc,
-                    total=steps_per_epoch)
+                    total=steps_per_epoch, telemetry=loop_tel)
                 # every epoch (not only eval epochs): loss, throughput, and
                 # the shape count — a bucketing misconfiguration shows up
                 # here as distinct_shapes churning mid-run
@@ -451,10 +517,17 @@ def main(argv=None) -> int:
                     metrics = evaluate(eval_step, state.params,
                                        test_batcher.epoch(0), put_fn=put,
                                        dataset_size=test_batcher.dataset_size,
-                                       batch_stats=state.batch_stats)
+                                       batch_stats=state.batch_stats,
+                                       telemetry=loop_tel)
                     mae = metrics["mae"]
                     epoch_metrics.update(mae=mae, mse=metrics["mse"])
-                logger.log(epoch_metrics, step=epoch)
+                # through the bus: the MetricLoggerSink forwards these
+                # scalars to stdout/wandb exactly as logger.log did, and
+                # the JSONL additionally records them as an epoch event.
+                # img_per_s is the GLOBAL (pod-aggregate) rate — num_valid
+                # is GSPMD-reduced in-program, so every host computes the
+                # same number and host 0's MetricLogger reports it.
+                telemetry.emit("epoch", step=epoch, **epoch_metrics)
                 if eval_epoch:
                     ckpt.save(epoch, state, mae=mae,
                               extra={"mse": metrics["mse"]})
@@ -472,6 +545,9 @@ def main(argv=None) -> int:
         test_batcher.close()
         ckpt.wait()
         ckpt.close()
+        if heartbeat is not None:
+            heartbeat.close()
+        telemetry.close()  # stops a still-open trace window, closes sinks
         logger.finish()
         shutdown_runtime()  # the reference never calls its cleanup()
     if main_proc:

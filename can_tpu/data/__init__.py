@@ -2,7 +2,7 @@ from .density import gaussian_density_map, generate_density_maps
 from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD, normalize_host
 from .batching import ShardedBatcher, Batch, pad_batch
 from .synthetic import make_synthetic_dataset
-from .prefetch import prefetch_to_device
+from .prefetch import PrefetchPutError, prefetch_to_device
 
 __all__ = [
     "gaussian_density_map",
@@ -16,4 +16,5 @@ __all__ = [
     "pad_batch",
     "make_synthetic_dataset",
     "prefetch_to_device",
+    "PrefetchPutError",
 ]

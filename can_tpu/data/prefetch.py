@@ -5,41 +5,89 @@ The reference gets this from torch DataLoader worker processes
 runs the (numpy) batch materialisation + host->device transfer while the
 device crunches the previous step — with JAX's async dispatch that is enough
 to hide the input pipeline entirely.
+
+Observability: pass ``stall=obs.StallClock()`` to account the seconds the
+CONSUMER spends blocked waiting for a batch that isn't ready — genuine
+input-pipeline starvation, the thing that silently caps throughput when the
+host can't keep up with the chip.  Time is added only when the popped
+future wasn't already done, so an overlapped (hidden) load costs zero.
 """
 
 from __future__ import annotations
 
 import collections
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator, Optional
+
+
+class PrefetchPutError(RuntimeError):
+    """``put_fn`` failed inside the prefetch worker thread.
+
+    The worker's exception only surfaces when its future is popped — up to
+    ``depth`` batches after the failing one, by which point "which batch?"
+    is gone from the traceback (the generator frame swallowed it).  This
+    wrapper pins the failing batch index; the original exception rides
+    along as ``__cause__`` with its full worker-thread traceback."""
+
+    def __init__(self, batch_index: int):
+        super().__init__(f"put_fn failed on batch {batch_index} "
+                         f"(prefetched in a worker thread; see the chained "
+                         f"cause for the original traceback)")
+        self.batch_index = batch_index
 
 
 def prefetch_to_device(batches: Iterable, put_fn: Callable, *,
-                       depth: int = 2) -> Iterator:
+                       depth: int = 2, stall=None) -> Iterator:
     """Yield ``put_fn(batch)`` for each batch, computed ``depth`` ahead in a
-    background thread.  depth<=0 disables prefetching."""
+    background thread.  depth<=0 disables prefetching (synchronous path:
+    exceptions propagate untouched, and ``stall`` accounts the full load
+    time — nothing overlaps it)."""
     if depth <= 0:
         for b in batches:
-            yield put_fn(b)
+            if stall is not None:
+                t0 = time.perf_counter()
+                out = put_fn(b)
+                stall.add(time.perf_counter() - t0)
+                yield out
+            else:
+                yield put_fn(b)
         return
 
     it = iter(batches)
     _done = object()
+    n_submitted = 0
 
-    def load_next():
+    def load_next(index: int):
         try:
-            return put_fn(next(it))
+            batch = next(it)
         except StopIteration:
             return _done
+        try:
+            return put_fn(batch)
+        except Exception as e:
+            raise PrefetchPutError(index) from e
+
+    def submit():
+        nonlocal n_submitted
+        fut = ex.submit(load_next, n_submitted)
+        n_submitted += 1
+        return fut
 
     ex = ThreadPoolExecutor(max_workers=1)
     try:
-        queue = collections.deque(ex.submit(load_next) for _ in range(depth))
+        queue = collections.deque(submit() for _ in range(depth))
         while queue:
-            result = queue.popleft().result()
+            fut = queue.popleft()
+            if stall is not None and not fut.done():
+                t0 = time.perf_counter()
+                result = fut.result()
+                stall.add(time.perf_counter() - t0)
+            else:
+                result = fut.result()
             if result is _done:
                 break
-            queue.append(ex.submit(load_next))
+            queue.append(submit())
             yield result
     finally:
         # On consumer abandonment (GeneratorExit: a raised

@@ -1,0 +1,57 @@
+"""can_tpu.obs — structured telemetry: event bus, sources, trace windows.
+
+Quickstart (what the CLIs wire up from ``--telemetry-dir``)::
+
+    from can_tpu import obs
+
+    tel = obs.open_host_telemetry(out_dir, host_id=process_index())
+    hb = obs.Heartbeat(tel, interval_s=60)
+    try:
+        state, stats = train_one_epoch(step, state, batches,
+                                       put_fn=put, telemetry=tel)
+        tel.emit("epoch", step=epoch, train_loss=stats.loss)
+    finally:
+        hb.close()
+        tel.close()
+
+Every layer that does device work takes an optional ``telemetry`` and
+stays zero-cost when it is None — the hot path never pays for
+observability it didn't ask for.
+"""
+
+from .bus import (
+    EVENT_KINDS,
+    JsonlSink,
+    MetricLoggerSink,
+    StdoutSink,
+    Telemetry,
+    open_host_telemetry,
+)
+from .report import format_report, read_events, summarize
+from .sources import (
+    Heartbeat,
+    RecompileTracker,
+    StallClock,
+    device_memory_snapshot,
+    emit_memory,
+)
+from .trace import StepTraceWindow, parse_trace_steps
+
+__all__ = [
+    "EVENT_KINDS",
+    "Heartbeat",
+    "JsonlSink",
+    "MetricLoggerSink",
+    "RecompileTracker",
+    "StallClock",
+    "StdoutSink",
+    "StepTraceWindow",
+    "Telemetry",
+    "device_memory_snapshot",
+    "emit_memory",
+    "format_report",
+    "open_host_telemetry",
+    "parse_trace_steps",
+    "read_events",
+    "summarize",
+]

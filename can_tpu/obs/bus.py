@@ -1,0 +1,191 @@
+"""Telemetry event bus: one process-local stream, pluggable sinks.
+
+The reference repo's observability is tqdm bars and optional wandb scalars
+(SURVEY §5: "Tracing/profiling: ABSENT"); until this subsystem can_tpu
+mirrored that.  A production pod needs a machine-readable record of where
+each step's time and memory went — recompiles, input stalls, HBM pressure —
+that survives the process and is diffable across runs and hosts.
+
+Schema: one JSON object per line, identical across train / eval / bench so
+artifacts are directly comparable::
+
+    {"ts": <unix seconds>, "kind": <str>, "step": <int|null>,
+     "host_id": <int>, "payload": {...}}
+
+Kinds emitted by the library: ``compile`` (new (shape, dtype) signature hit
+a jitted step, with elapsed first-call time), ``step_window`` (a windowed
+batch of per-step wall times), ``stall`` (seconds the consumer spent
+blocked on the input pipeline), ``memory`` (device/host memory snapshot),
+``heartbeat`` (liveness timestamp from a daemon thread), ``epoch``
+(per-epoch scalars — the row wandb used to get directly), ``bench``
+(benchmark result records), ``run`` (run-level config, emitted once).
+Sinks must tolerate kinds they don't know: the set is open.
+
+Multi-host: every host writes its OWN file (``telemetry.host{k}.jsonl``,
+see ``open_host_telemetry``) — no cross-host collectives on the hot path;
+merging is an offline join on ``ts``/``host_id``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+# the kinds the acceptance contract and tools/telemetry_report.py know;
+# informational — emit() accepts any kind string
+EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
+               "epoch", "bench", "run")
+
+
+def _jsonable(v):
+    """Coerce numpy scalars/arrays into JSON-serialisable python values."""
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+class JsonlSink:
+    """Append events to a JSONL file, one line per event, flushed per event
+    (an abandoned run's last heartbeat must be ON DISK, not in a buffer)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def emit(self, event: dict) -> None:
+        self._f.write(json.dumps(event) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class StdoutSink:
+    """Human-greppable one-liners; for quick local runs without a dir."""
+
+    def __init__(self, prefix: str = "[telemetry]"):
+        self.prefix = prefix
+
+    def emit(self, event: dict) -> None:
+        step = event.get("step")
+        print(f"{self.prefix} {event['kind']}"
+              f"{'' if step is None else f' step {step}'} "
+              f"{json.dumps(event['payload'])}", flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class MetricLoggerSink:
+    """Forward scalar payload entries of selected kinds to a MetricLogger,
+    so the existing stdout/wandb logging keeps working unchanged when the
+    CLI routes its per-epoch metrics through the bus."""
+
+    def __init__(self, logger, kinds=("epoch",)):
+        self.logger = logger
+        self.kinds = tuple(kinds)
+
+    def emit(self, event: dict) -> None:
+        if event["kind"] not in self.kinds:
+            return
+        scalars = {k: v for k, v in event["payload"].items()
+                   if isinstance(v, (int, float, np.floating, np.integer))
+                   and not isinstance(v, bool)}
+        if scalars:
+            self.logger.log(scalars, step=event.get("step"))
+
+    def close(self) -> None:
+        pass  # the CLI owns the logger's lifecycle (logger.finish())
+
+
+class Telemetry:
+    """The bus: builds schema'd events and fans them out to sinks.
+
+    Thread-safe (the heartbeat thread emits concurrently with the train
+    loop).  A sink that raises is dropped after one warning — telemetry
+    must never kill a training run.  ``step_tick()`` maintains the
+    process-global step counter (counts from 0 at construction; a resumed
+    run restarts the count — ``step`` in events is a run-local ordinal,
+    not the optimizer step) and drives the optional trace window.
+    """
+
+    def __init__(self, sinks=(), *, host_id: int = 0, trace=None,
+                 clock=time.time):
+        self._sinks = list(sinks)
+        self.host_id = host_id
+        self.trace = trace
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._step = 0
+        # RecompileTracker keeps per-wrapped-step-name signature sets here
+        # so re-wrapping each epoch doesn't re-attribute old signatures
+        self.signature_registry: dict = {}
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def step_tick(self) -> int:
+        """Advance the run-local step counter; drives the trace window."""
+        with self._lock:
+            self._step += 1
+            step = self._step
+        if self.trace is not None:
+            self.trace.on_step(step)
+        return step
+
+    def emit(self, kind: str, *, step: Optional[int] = None,
+             **payload) -> None:
+        event = {"ts": self._clock(), "kind": kind,
+                 "step": self._step if step is None else int(step),
+                 "host_id": self.host_id, "payload": _jsonable(payload)}
+        with self._lock:
+            for sink in self._sinks:
+                try:
+                    sink.emit(event)
+                    sink._telemetry_warned = False
+                except Exception as e:  # noqa: BLE001 — never kill the run
+                    # KEEP the sink and retry on the next event: one
+                    # transient wandb/filesystem hiccup must not silently
+                    # end the run's primary metric record (warn once per
+                    # failure streak, not once per event)
+                    if not getattr(sink, "_telemetry_warned", False):
+                        sink._telemetry_warned = True
+                        print(f"[telemetry] sink {type(sink).__name__} "
+                              f"failed ({type(e).__name__}: {e}); kept — "
+                              f"will retry on the next event", flush=True)
+
+    def close(self) -> None:
+        if self.trace is not None:
+            self.trace.close()
+            self.trace = None
+        with self._lock:
+            for sink in self._sinks:
+                try:
+                    sink.close()
+                except Exception:
+                    pass
+            self._sinks = []
+
+
+def open_host_telemetry(telemetry_dir: str, *, host_id: int = 0,
+                        extra_sinks=(), trace=None) -> Telemetry:
+    """The standard wiring: ``<dir>/telemetry.host{k}.jsonl`` for THIS host
+    plus any extra sinks.  Every host calls this with its own
+    ``process_index()`` — per-host files, no cross-host coordination."""
+    sinks = [JsonlSink(os.path.join(telemetry_dir,
+                                    f"telemetry.host{host_id}.jsonl"))]
+    sinks.extend(extra_sinks)
+    return Telemetry(sinks, host_id=host_id, trace=trace)

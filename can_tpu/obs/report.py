@@ -1,0 +1,146 @@
+"""Summarize a telemetry JSONL into the numbers an operator asks first.
+
+``tools/telemetry_report.py`` is the CLI; this module is the importable
+(and tier-1-tested) core: read events, aggregate, format one table.
+Tolerant by design — unknown kinds are counted and otherwise ignored, and
+a truncated last line (a run killed mid-write) is skipped, because the
+reader's job is post-mortem triage of exactly such runs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+
+def read_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail write of a killed run
+    return events
+
+
+def _percentile(samples: List[float], q: float) -> Optional[float]:
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples, np.float64), q))
+
+
+def summarize(events: Iterable[dict]) -> dict:
+    """Aggregate one host's event stream.  Step-time percentiles pool the
+    raw per-step samples every ``step_window`` event carries, so they are
+    exact over the run, not a merge of per-window approximations."""
+    events = list(events)
+    by_kind: dict = {}
+    samples: List[float] = []
+    steps = 0
+    images = 0.0
+    compile_s = 0.0
+    stall_s = 0.0
+    stall_events = 0
+    peak_hbm = None
+    peak_rss_mb = None
+    first_ts = None
+    last_ts = None
+    last_heartbeat_ts = None
+    epochs = set()
+    for e in events:
+        kind = e.get("kind", "?")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            first_ts = ts if first_ts is None else min(first_ts, ts)
+            last_ts = ts if last_ts is None else max(last_ts, ts)
+        p = e.get("payload", {})
+        if kind == "step_window":
+            steps += int(p.get("steps", 0))
+            images += float(p.get("images", 0.0))
+            samples.extend(float(s) for s in p.get("samples_s", ()))
+        elif kind == "compile":
+            compile_s += float(p.get("seconds", 0.0))
+        elif kind == "stall":
+            stall_s += float(p.get("seconds", 0.0))
+            stall_events += int(p.get("count", 0))
+        elif kind == "memory":
+            for d in p.get("devices", ()):
+                for key in ("peak_bytes_in_use", "bytes_in_use"):
+                    if key in d:
+                        v = int(d[key])
+                        peak_hbm = v if peak_hbm is None else max(peak_hbm, v)
+                        break
+            rss = p.get("host_rss_mb")
+            if rss is not None:
+                peak_rss_mb = (rss if peak_rss_mb is None
+                               else max(peak_rss_mb, rss))
+        elif kind == "heartbeat":
+            last_heartbeat_ts = (ts if last_heartbeat_ts is None
+                                 else max(last_heartbeat_ts, ts))
+        elif kind == "epoch":
+            if e.get("step") is not None:
+                epochs.add(int(e["step"]))
+    wall_s = (last_ts - first_ts) if first_ts is not None else None
+    return {
+        "events": len(events),
+        "by_kind": dict(sorted(by_kind.items())),
+        "steps": steps,
+        "images": images,
+        "epochs": len(epochs),
+        "wall_s": round(wall_s, 3) if wall_s is not None else None,
+        "step_p50_s": _percentile(samples, 50),
+        "step_p95_s": _percentile(samples, 95),
+        "step_max_s": max(samples) if samples else None,
+        "recompiles": by_kind.get("compile", 0),
+        "compile_s": round(compile_s, 3),
+        "stall_s": round(stall_s, 3),
+        "stall_events": stall_events,
+        "peak_hbm_bytes": peak_hbm,
+        "peak_host_rss_mb": peak_rss_mb,
+        "heartbeats": by_kind.get("heartbeat", 0),
+        "last_heartbeat_ts": last_heartbeat_ts,
+    }
+
+
+def _fmt(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}{unit}"
+    return f"{v}{unit}"
+
+
+def format_report(summary: dict, *, title: str = "telemetry") -> str:
+    """One aligned two-column table; the whole contract of the CLI tool."""
+    gib = (summary["peak_hbm_bytes"] / 2**30
+           if summary["peak_hbm_bytes"] is not None else None)
+    rows = [
+        ("events", _fmt(summary["events"])),
+        ("kinds", " ".join(f"{k}={n}"
+                           for k, n in summary["by_kind"].items()) or "-"),
+        ("epochs", _fmt(summary["epochs"])),
+        ("steps", _fmt(summary["steps"])),
+        ("images", _fmt(summary["images"])),
+        ("wall", _fmt(summary["wall_s"], " s")),
+        ("step p50", _fmt(summary["step_p50_s"], " s")),
+        ("step p95", _fmt(summary["step_p95_s"], " s")),
+        ("step max", _fmt(summary["step_max_s"], " s")),
+        ("recompiles", _fmt(summary["recompiles"])),
+        ("compile time", _fmt(summary["compile_s"], " s")),
+        ("input stall", _fmt(summary["stall_s"], " s")),
+        ("peak HBM", _fmt(round(gib, 3) if gib is not None else None,
+                          " GiB")),
+        ("peak host RSS", _fmt(summary["peak_host_rss_mb"], " MB")),
+        ("heartbeats", _fmt(summary["heartbeats"])),
+    ]
+    width = max(len(k) for k, _ in rows)
+    lines = [f"# {title}"]
+    lines += [f"{k.ljust(width)}  {v}" for k, v in rows]
+    return "\n".join(lines)

@@ -1,0 +1,83 @@
+"""Step-range profiler trigger: trace a WINDOW instead of the whole run.
+
+``profile_trace`` (utils/profiling.py) wraps the entire run — fine for a
+smoke run, useless for "steady-state steps 10..12 of a 10-hour job" where
+a whole-run trace is gigabytes of mostly-identical timelines.  Here the
+``jax.profiler`` trace is armed by the run-local step counter: the CLI's
+``--trace-steps A:B`` (python slice semantics: first traced step A,
+first untraced step B) starts the trace when step A begins and stops it
+when step B begins, so the artifact holds exactly ``B - A`` steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def parse_trace_steps(spec: str) -> Optional[Tuple[int, int]]:
+    """``"10:13"`` -> ``(10, 13)``; empty/None -> None.  Slice semantics:
+    steps ``[10, 13)`` are traced.  Raises ValueError on malformed specs
+    (argparse ``type=`` surfaces it as a usage error before any work)."""
+    if not spec:
+        return None
+    try:
+        lo_s, hi_s = spec.split(":")
+        lo, hi = int(lo_s), int(hi_s)
+    except ValueError:
+        raise ValueError(
+            f"--trace-steps wants START:STOP (e.g. 10:13), got {spec!r}")
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"--trace-steps window must satisfy 0 <= START < STOP, "
+            f"got {spec!r}")
+    return lo, hi
+
+
+class StepTraceWindow:
+    """Start/stop a ``jax.profiler`` trace on run-local step boundaries.
+
+    ``on_step(step)`` is called once per step (step counts from 1, see
+    ``Telemetry.step_tick``; the window is interpreted on the 0-based step
+    ORDINAL, so ``--trace-steps 0:2`` traces the first two steps).  Safe to
+    call after the window has passed — both branches are a pair of integer
+    compares.  ``close()`` stops a still-open trace (a window extending
+    past the last step must still flush its file)."""
+
+    def __init__(self, log_dir: str, start: int, stop: int,
+                 *, profiler=None):
+        if not log_dir:
+            raise ValueError("StepTraceWindow needs a log_dir "
+                             "(pass --profile-dir with --trace-steps)")
+        self.log_dir = log_dir
+        self.start = int(start)
+        self.stop = int(stop)
+        self._active = False
+        self._done = False
+        self._profiler = profiler  # test seam; defaults to jax.profiler
+
+    def _jax_profiler(self):
+        if self._profiler is None:
+            import jax.profiler
+
+            self._profiler = jax.profiler
+        return self._profiler
+
+    def on_step(self, step: int) -> None:
+        ordinal = step - 1  # step_tick counts from 1
+        if (not self._active and not self._done
+                and self.start <= ordinal < self.stop):
+            self._jax_profiler().start_trace(self.log_dir)
+            self._active = True
+        elif self._active and ordinal >= self.stop:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        try:
+            self._jax_profiler().stop_trace()
+        finally:
+            self._active = False
+            self._done = True  # one window per run: never re-arm
+
+    def close(self) -> None:
+        if self._active:
+            self._stop_trace()

@@ -1,6 +1,6 @@
 from .loss import masked_mse_sum, density_counts
 from .state import TrainState, create_train_state, make_optimizer, make_lr_schedule
-from .steps import make_train_step, make_eval_step, normalize_on_device, NonFiniteLossError
+from .steps import batch_signature, make_train_step, make_eval_step, normalize_on_device, NonFiniteLossError
 from .loop import EpochStats, evaluate, train_one_epoch
 
 __all__ = [
@@ -10,6 +10,7 @@ __all__ = [
     "create_train_state",
     "make_optimizer",
     "make_lr_schedule",
+    "batch_signature",
     "make_train_step",
     "make_eval_step",
     "normalize_on_device",

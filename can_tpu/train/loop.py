@@ -60,10 +60,58 @@ class EpochStats(NamedTuple):
         return self.images / self.seconds if self.seconds > 0 else 0.0
 
 
+def _arm_telemetry(telemetry, step_fn, *, name: str):
+    """Shared train/eval instrumentation setup.  Returns
+    ``(wrapped_step_fn, timer, stall_clock)`` — all pass-throughs /
+    None when telemetry is off, so the uninstrumented hot path is
+    byte-identical to before (the <2% bench-overhead contract)."""
+    if telemetry is None:
+        return step_fn, None, None
+    from can_tpu.obs import RecompileTracker, StallClock
+    from can_tpu.utils.profiling import StepTimer
+
+    # signatures live on the telemetry object, so re-wrapping every epoch
+    # re-attributes nothing; first-call-per-signature wall time = compile
+    return (RecompileTracker(step_fn, telemetry, name=name),
+            StepTimer(skip_first=0), StallClock())
+
+
+def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
+                          epoch: int, seconds: float) -> None:
+    """Epoch-boundary events: stall accounting + device-memory snapshot +
+    the step-time reservoir summary (per-shape breakdown included)."""
+    from can_tpu.obs import emit_memory
+
+    telemetry.emit("stall", phase=phase, epoch=epoch,
+                   seconds=round(stall.seconds, 4), count=stall.count,
+                   frac_of_epoch=round(stall.seconds / seconds, 4)
+                   if seconds > 0 else 0.0)
+    telemetry.emit("step_window", phase=phase, epoch=epoch, steps=0,
+                   samples_s=[], closes_epoch=True,
+                   **timer.percentiles(), shapes=timer.shape_summary())
+    emit_memory(telemetry, where=f"{phase}_epoch_{epoch}_end")
+
+
+def _emit_step_window(telemetry, samples, *, steps: int, phase: str,
+                      epoch: int, t_window: float, images: float) -> float:
+    """One ``step_window`` event per metric-flush window.  The samples are
+    host-side step intervals (no per-step fence — that would serialise the
+    dispatch pipeline); the flush step absorbs the device sync, so the
+    window's sample SUM is honest wall time while individual samples are
+    dispatch-biased.  ``steps`` counts every step in the window; samples
+    exclude first-call compiles (attributed by their own compile events),
+    so ``len(samples_s)`` can be smaller.  Returns the new window start."""
+    now = time.perf_counter()
+    telemetry.emit("step_window", phase=phase, epoch=epoch, steps=steps,
+                   seconds=round(now - t_window, 4), images=images,
+                   samples_s=[round(s, 6) for s in samples])
+    return now
+
+
 def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                     put_fn: Callable, epoch: int = 0, show_progress: bool = True,
                     check_finite: bool = True, total: Optional[int] = None,
-                    prefetch: int = 2, check_every: int = 8):
+                    prefetch: int = 2, check_every: int = 8, telemetry=None):
     """Run one epoch; returns (state, EpochStats).
 
     train_step: jitted (state, batch_dict) -> (state, metrics).
@@ -74,31 +122,65 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
       sync covering the whole window (loss accumulation + non-finite abort
       check), so larger windows keep the device queue fuller at the cost of
       later divergence detection.
+    telemetry: optional ``obs.Telemetry``; when given the loop emits
+      ``compile`` (new batch signature -> first-call time), ``step_window``
+      (per metric-flush window), and epoch-boundary ``stall``/``memory``
+      events.  None keeps the hot path untouched.
     """
     from can_tpu.data.prefetch import prefetch_to_device
 
+    train_step, timer, stall = _arm_telemetry(telemetry, train_step,
+                                              name="train_step")
     loss_sum = 0.0
     img_sum = 0.0
+    flushed_img = 0.0  # img_sum at the last window flush (per-window delta)
+    flushed_steps = 0  # steps at the last window flush
     steps = 0
     shapes = set()
     pending = []  # still-async metrics awaiting a windowed flush
     t0 = time.perf_counter()
-    it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch),
+    t_window = t0
+    it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch,
+                                      stall=stall),
                    enabled=show_progress, desc=f"epoch {epoch}", total=total)
     for dev_batch in it:
-        shapes.add(tuple(dev_batch["image"].shape))
+        shape = tuple(dev_batch["image"].shape)
+        shapes.add(shape)
+        if telemetry is not None:
+            telemetry.step_tick()
+            timer.start()
         state, metrics = train_step(state, dev_batch)
+        if telemetry is not None:
+            # a first-call compile is attributed by its own compile event;
+            # recording it here too would poison the step p95/max
+            timer.stop(shape=shape, record=not train_step.last_first_call)
         pending.append(metrics)
         steps += 1
         if len(pending) >= max(check_every, 1):
             loss_sum, img_sum = _flush(pending, loss_sum, img_sum,
                                        check_finite, epoch, steps)
             pending = []
+            if telemetry is not None:
+                t_window = _emit_step_window(
+                    telemetry, timer.drain_window(),
+                    steps=steps - flushed_steps, phase="train",
+                    epoch=epoch, t_window=t_window,
+                    images=img_sum - flushed_img)
+                flushed_img = img_sum
+                flushed_steps = steps
             if show_progress and hasattr(it, "set_postfix") and img_sum:
                 it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
     loss_sum, img_sum = _flush(pending, loss_sum, img_sum, check_finite,
                                epoch, steps)
     seconds = time.perf_counter() - t0
+    if telemetry is not None:
+        tail = timer.drain_window()
+        if tail or steps > flushed_steps:  # partial trailing window
+            _emit_step_window(telemetry, tail, steps=steps - flushed_steps,
+                              phase="train", epoch=epoch, t_window=t_window,
+                              images=img_sum - flushed_img)
+        _emit_epoch_telemetry(telemetry, timer, stall, phase="train",
+                              epoch=epoch, seconds=seconds)
     stats = EpochStats(loss_sum / max(img_sum, 1.0), seconds=seconds,
                        images=img_sum, steps=steps,
                        distinct_shapes=len(shapes))
@@ -129,7 +211,8 @@ def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count):
 def evaluate(eval_step: Callable, params, batches: Iterable, *,
              put_fn: Callable, dataset_size: int, show_progress: bool = False,
              total: Optional[int] = None, batch_stats=None,
-             check_every: int = 4, prefetch: int = 2) -> dict:
+             check_every: int = 4, prefetch: int = 2,
+             telemetry=None) -> dict:
     """Dataset MAE and (paper-style) RMSE over the eval set.
 
     eval_step returns global sums (see train/steps.py), so accumulating on
@@ -144,20 +227,32 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
     """
     from can_tpu.data.prefetch import prefetch_to_device
 
+    eval_step, timer, stall = _arm_telemetry(telemetry, eval_step,
+                                             name="eval_step")
     abs_sum = 0.0
     sq_sum = 0.0
     n_seen = 0.0
     pending = []  # async per-batch metric trees, fetched in windows
-    it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch),
+    t0 = time.perf_counter()
+    t_window = t0
+    it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch,
+                                      stall=stall),
                    enabled=show_progress, desc="eval", total=total)
 
     def flush():
-        nonlocal abs_sum, sq_sum, n_seen
+        nonlocal abs_sum, sq_sum, n_seen, t_window
+        n_before = n_seen
+        window = len(pending)
         for m in jax.device_get(pending):
             abs_sum += float(m["abs_err_sum"])
             sq_sum += float(m["sq_err_sum"])
             n_seen += float(m["num_valid"])
         pending.clear()
+        if telemetry is not None and window:
+            t_window = _emit_step_window(telemetry, timer.drain_window(),
+                                         steps=window, phase="eval",
+                                         epoch=0, t_window=t_window,
+                                         images=n_seen - n_before)
 
     for dev_batch in it:
         # don't fetch per step: each device_get is a host<->device round
@@ -168,10 +263,19 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
         # in HBM, so the default stays small (4) — at UCF-QNRF image sizes
         # each staged batch is hundreds of MB; raise it for small-image
         # evals where the round trips dominate.
+        shape = tuple(dev_batch["image"].shape)
+        if telemetry is not None:
+            telemetry.step_tick()
+            timer.start()
         pending.append(eval_step(params, dev_batch, batch_stats))
+        if telemetry is not None:
+            timer.stop(shape=shape, record=not eval_step.last_first_call)
         if len(pending) >= max(check_every, 1):
             flush()
     flush()
+    if telemetry is not None:
+        _emit_epoch_telemetry(telemetry, timer, stall, phase="eval",
+                              epoch=0, seconds=time.perf_counter() - t0)
     if int(n_seen) != dataset_size:
         raise RuntimeError(
             f"eval saw {int(n_seen)} valid samples, expected {dataset_size}")

@@ -23,6 +23,19 @@ import jax.numpy as jnp
 from can_tpu.train.loss import density_counts, masked_mse_sum
 
 
+def batch_signature(batch) -> tuple:
+    """The (shape, dtype) signature jit keys its executable cache on, for a
+    batch dict: sorted ``(name, shape, dtype)`` triples.  A new signature
+    hitting a jitted step means trace + lower + compile on the calling
+    thread — ``obs.RecompileTracker`` uses this to attribute that bill to
+    the batch that incurred it (``EpochStats.distinct_shapes`` counts
+    image shapes only; masks/dtypes can recompile too, e.g. --u8-input
+    flips the image dtype without touching the shape)."""
+    return tuple(sorted(
+        (k, tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
+        for k, v in batch.items() if hasattr(v, "shape")))
+
+
 def normalize_on_device(image, pixel_mask):
     """uint8 pixels -> ImageNet-normalised f32, inside the compiled step.
 

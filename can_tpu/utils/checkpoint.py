@@ -22,34 +22,39 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
         import orbax.checkpoint as ocp
-        from orbax.checkpoint.checkpoint_managers import (
-            AnyPreservationPolicy,
-            BestN,
-            LatestN,
-        )
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self.manager = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                # best_fn/best_mode drive best_step() selection; RETENTION
-                # is the joint policy below.  `max_to_keep` alone with a
-                # best_fn keeps only the N best (orbax BestN semantics) —
-                # on a long run whose MAE plateaus early that silently
-                # garbage-collects every later save, so a crash-resume
-                # rolled training back hundreds of epochs (code-review
-                # r5).  Keep the N best AND always the latest.
-                best_fn=lambda m: m["mae"],
-                best_mode="min",
-                preservation_policy=AnyPreservationPolicy(policies=[
+        # best_fn/best_mode drive best_step() selection; RETENTION is the
+        # joint policy below.  `max_to_keep` alone with a best_fn keeps
+        # only the N best (orbax BestN semantics) — on a long run whose
+        # MAE plateaus early that silently garbage-collects every later
+        # save, so a crash-resume rolled training back hundreds of epochs
+        # (code-review r5).  Keep the N best AND always the latest.
+        opt_kwargs = dict(best_fn=lambda m: m["mae"], best_mode="min")
+        try:
+            from orbax.checkpoint.checkpoint_managers import (
+                AnyPreservationPolicy,
+                BestN,
+                LatestN,
+            )
+
+            opt_kwargs["preservation_policy"] = AnyPreservationPolicy(
+                policies=[
                     BestN(get_metric_fn=lambda m: m["mae"],
                           reverse=True, n=max_to_keep),
                     LatestN(n=1),
-                ]),
-            ),
-        )
+                ])
+        except ImportError:
+            # older orbax (< preservation_policy API): degrade to best-N
+            # retention — best_step()/resume still work, but the latest
+            # checkpoint is NOT guaranteed to survive when its metric
+            # isn't top-N (the r5 rollback hazard returns; upgrade orbax
+            # to restore the joint policy)
+            opt_kwargs["max_to_keep"] = max_to_keep
+        self.manager = ocp.CheckpointManager(
+            self.directory, options=ocp.CheckpointManagerOptions(**opt_kwargs))
 
     def save(self, epoch: int, state: TrainState, *, mae: float,
              extra: Optional[dict] = None) -> bool:

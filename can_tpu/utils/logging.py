@@ -12,6 +12,8 @@ import os
 import uuid
 from typing import Optional
 
+import numpy as np
+
 
 def _stable_run_id(run_id_file: str) -> str:
     """Read (or mint and persist) a wandb run id next to the checkpoints, so
@@ -61,7 +63,10 @@ class MetricLogger:
     def log(self, metrics: dict, *, step: Optional[int] = None) -> None:
         if not self.enabled:
             return
-        line = " ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+        # np.floating too: fetched metrics arrive as numpy scalars
+        # (np.float32/np.float64), which used to fall through to raw repr
+        line = " ".join(f"{k}={v:.6g}"
+                        if isinstance(v, (float, np.floating)) else f"{k}={v}"
                         for k, v in metrics.items())
         print(f"[metrics]{'' if step is None else f' step {step}'} {line}")
         if self._wandb is not None:

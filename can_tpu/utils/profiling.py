@@ -109,28 +109,87 @@ def profile_trace(log_dir: Optional[str]):
 
 
 class StepTimer:
-    """Rolling mean of step wall-times, excluding the first (compile) steps."""
+    """Step wall-time accounting: rolling mean, bounded sample reservoir
+    (p50/p95/max), and optional per-shape-bucket breakdown.
 
-    def __init__(self, skip_first: int = 2):
+    The first ``skip_first`` steps are excluded from every statistic (they
+    carry compile time; ``mean`` is NaN until a post-skip step lands).
+    The reservoir keeps the most recent ``reservoir`` samples (deque, not
+    true reservoir sampling: for telemetry the RECENT distribution is the
+    one that predicts the next hour).  ``stop(shape=...)`` tags the sample
+    with its batch bucket so a bimodal p95 can be attributed to the bucket
+    causing it instead of read as noise."""
+
+    def __init__(self, skip_first: int = 2, reservoir: int = 4096):
+        import collections
+
         self.skip_first = skip_first
         self._count = 0
         self._total = 0.0
         self._last: Optional[float] = None
+        self._samples = collections.deque(maxlen=max(int(reservoir), 1))
+        self._window: list = []  # samples since the last drain_window()
+        self._shapes: dict = {}  # shape -> [count, total_s]
 
     def start(self) -> None:
         self._last = time.perf_counter()
 
-    def stop(self, result=None) -> float:
-        """Fence on ``result`` (if given) and record the elapsed time."""
+    def stop(self, result=None, *, shape=None, record: bool = True) -> float:
+        """Fence on ``result`` (if given) and record the elapsed time.
+
+        In an async-dispatch loop, call WITHOUT ``result``: the sample is
+        then the host-side step interval (the window-flush step absorbs
+        the device sync), whose sum over a window is honest wall time.
+        ``record=False`` measures but records nothing — for steps whose
+        time is accounted elsewhere (a first-call compile, attributed by
+        its own ``compile`` event; folding it in here would let one 10 s
+        compile masquerade as the steady-state p95/max)."""
+        if self._last is None:
+            raise RuntimeError("StepTimer.stop() before start()")
         if result is not None:
             jax.block_until_ready(result)
         dt = time.perf_counter() - self._last
+        self._last = None
+        if not record:
+            return dt
         self._count += 1
         if self._count > self.skip_first:
             self._total += dt
+            self._samples.append(dt)
+            self._window.append(dt)
+            if shape is not None:
+                rec = self._shapes.setdefault(shape, [0, 0.0])
+                rec[0] += 1
+                rec[1] += dt
         return dt
 
     @property
     def mean(self) -> float:
         n = self._count - self.skip_first
         return self._total / n if n > 0 else float("nan")
+
+    def percentiles(self) -> dict:
+        """``{n, p50_s, p95_s, max_s}`` over the reservoir (post-skip
+        samples); Nones when nothing has been recorded yet."""
+        if not self._samples:
+            return {"n": 0, "p50_s": None, "p95_s": None, "max_s": None}
+        import numpy as np
+
+        arr = np.asarray(self._samples, np.float64)
+        return {"n": int(arr.size),
+                "p50_s": float(np.percentile(arr, 50)),
+                "p95_s": float(np.percentile(arr, 95)),
+                "max_s": float(arr.max())}
+
+    def shape_summary(self) -> dict:
+        """Per-bucket breakdown: ``{shape_str: {n, total_s, mean_s}}``."""
+        return {str(shape): {"n": n, "total_s": round(total, 4),
+                             "mean_s": round(total / n, 6)}
+                for shape, (n, total) in sorted(self._shapes.items(),
+                                                key=lambda kv: str(kv[0]))}
+
+    def drain_window(self) -> list:
+        """Return (and reset) the samples recorded since the last drain —
+        the per-window payload for ``step_window`` telemetry events."""
+        window, self._window = self._window, []
+        return window

@@ -61,6 +61,11 @@ class TestCheckpointManager:
         # run rolled training back to an old epoch.  The joint policy
         # must keep the newest checkpoint alongside the N best, and it
         # must be restorable.
+        # The joint policy needs orbax's preservation_policy API; on older
+        # orbax CheckpointManager degrades to best-N retention (documented
+        # in utils/checkpoint.py) and this guarantee doesn't hold.
+        pytest.importorskip("orbax.checkpoint.checkpoint_managers",
+                            reason="orbax too old for preservation_policy")
         params = cannet_init(jax.random.key(0))
         opt = make_optimizer(make_lr_schedule(1e-7))
         state = create_train_state(params, opt)
@@ -113,6 +118,67 @@ class TestTrainCLI:
                           "--show-index", "0",
                           "--out-dir", str(tmp_path / "viz")]) == 0
         assert any(f.endswith(".png") for f in os.listdir(tmp_path / "viz"))
+
+    def test_telemetry_dir_records_every_event_kind(self, data_root,
+                                                    tmp_path):
+        """Acceptance (this PR): a 2-epoch synthetic run with
+        --telemetry-dir writes a parseable per-host JSONL containing >=1
+        event of each kind — compile, step_window, stall, memory,
+        heartbeat, epoch — and tools/telemetry_report.py summarizes it."""
+        import json
+
+        from can_tpu import obs
+        from can_tpu.cli.test import main as test_main
+        from can_tpu.cli.train import main as train_main
+
+        tdir = str(tmp_path / "telemetry")
+        ckdir = str(tmp_path / "ckpt_tel")
+        argv = ["--data_root", data_root, "--epochs", "2",
+                "--batch-size", "1", "--lr", "1e-7",
+                "--checkpoint-dir", ckdir, "--seed", "0",
+                "--telemetry-dir", tdir,
+                "--telemetry-heartbeat-s", "0.2"]
+        assert train_main(argv) == 0
+        path = os.path.join(tdir, "telemetry.host0.jsonl")
+        events = [json.loads(l) for l in open(path)]  # every line parses
+        kinds = {e["kind"] for e in events}
+        assert {"compile", "step_window", "stall", "memory", "heartbeat",
+                "epoch"} <= kinds, kinds
+        for e in events:
+            assert set(e) == {"ts", "kind", "step", "host_id", "payload"}
+        # epoch events carry the wandb-bound scalars (the MetricLogger
+        # adapter forwards exactly these)
+        ep = [e for e in events if e["kind"] == "epoch"]
+        assert len(ep) == 2 and "train_loss" in ep[0]["payload"]
+        assert "mae" in ep[-1]["payload"]
+        # the report summarizes without error and sees real steps
+        summary = obs.summarize(events)
+        assert summary["steps"] > 0
+        assert summary["recompiles"] >= 1
+        assert summary["step_p95_s"] is not None
+
+        # the eval CLI writes the same schema to the same layout
+        tdir2 = str(tmp_path / "telemetry_eval")
+        assert test_main(["--data_root", data_root,
+                          "--checkpoint-dir", ckdir,
+                          "--telemetry-dir", tdir2,
+                          "--telemetry-heartbeat-s", "0.2"]) == 0
+        ev = obs.read_events(os.path.join(tdir2, "telemetry.host0.jsonl"))
+        ekinds = {e["kind"] for e in ev}
+        assert {"compile", "step_window", "stall", "memory", "heartbeat",
+                "epoch"} <= ekinds, ekinds
+        assert any(e["kind"] == "epoch" and "mae" in e["payload"]
+                   for e in ev)
+
+    def test_trace_steps_flag_validation(self, data_root):
+        from can_tpu.cli.train import main as train_main
+
+        with pytest.raises(SystemExit, match="START:STOP"):
+            train_main(["--data_root", data_root, "--epochs", "1",
+                        "--trace-steps", "nope"])
+        with pytest.raises(SystemExit, match="profile-dir"):
+            train_main(["--data_root", data_root, "--epochs", "1",
+                        "--trace-steps", "0:2"])
 
     def test_syncbn_train_then_eval(self, data_root, tmp_path):
         """BN-variant end to end through both CLIs: --syncBN trains the

@@ -349,6 +349,46 @@ class TestPrefetch:
         assert len(started) <= 3, started
         assert close_s < 0.15, close_s
 
+    def test_put_error_carries_batch_index_and_cause(self):
+        """Satellite (this PR): a put_fn exception inside the worker
+        thread used to surface as the bare original exception up to
+        ``depth`` batches late, with nothing saying WHICH batch died.
+        It must arrive as PrefetchPutError(batch_index=...) chaining the
+        original as __cause__."""
+        import pytest
+
+        from can_tpu.data import PrefetchPutError, prefetch_to_device
+
+        def put(x):
+            if x == 3:
+                raise ValueError("corrupt density map")
+            return x * 2
+
+        got = []
+        with pytest.raises(PrefetchPutError) as ei:
+            for v in prefetch_to_device(range(6), put, depth=4):
+                got.append(v)
+        assert ei.value.batch_index == 3
+        assert "batch 3" in str(ei.value)
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert got == [0, 2, 4]  # everything before the poisoned batch
+
+    def test_stall_clock_threading(self):
+        """prefetch_to_device(stall=...) is the loop's starvation probe:
+        a blocking producer must be charged, an overlapped one must not
+        (details pinned in tests/test_obs.py)."""
+        import time
+
+        from can_tpu.data import prefetch_to_device
+        from can_tpu.obs import StallClock
+
+        clock = StallClock()
+        out = list(prefetch_to_device(range(3),
+                                      lambda x: (time.sleep(0.02), x)[1],
+                                      depth=1, stall=clock))
+        assert out == [0, 1, 2]
+        assert clock.seconds > 0.0 and clock.count >= 1
+
 
 class TestNativeStamping:
     def test_native_matches_numpy(self):

@@ -57,6 +57,18 @@ class TestMetricLogger:
         assert capsys.readouterr().out == ""
         quiet.finish()
 
+    def test_numpy_scalars_format_like_floats(self, capsys):
+        """Fetched metrics arrive as np.float32/np.float64 scalars; they
+        must hit the %.6g float path, not raw repr (satellite, this PR:
+        np.float32(1/3) used to print as 0.33333334 or worse)."""
+        log = MetricLogger(enabled=True)
+        log.log({"a": np.float32(1.0) / 3, "b": np.float64(2.5),
+                 "n": np.int64(7)}, step=0)
+        out = capsys.readouterr().out
+        assert "a=0.333333 " in out  # %.6g, not float32 repr
+        assert "b=2.5" in out and "n=7" in out
+        log.finish()
+
     def test_wandb_absent_degrades(self, capsys, monkeypatch):
         # force the absent-wandb path regardless of the environment:
         # requesting wandb must fall back to stdout, not crash (the
@@ -97,6 +109,89 @@ class TestCompileCache:
 
         monkeypatch.setenv("CAN_TPU_COMPILE_CACHE", str(tmp_path))
         assert default_cache_dir() == str(tmp_path)
+
+
+class TestStepTimer:
+    """Edge cases load-bearing in bench entry points (satellite, this PR):
+    the NaN-before-warmup contract and the misuse guard."""
+
+    def test_mean_is_nan_before_skip_first(self):
+        import math
+
+        from can_tpu.utils import StepTimer
+
+        t = StepTimer(skip_first=2)
+        for _ in range(2):
+            t.start()
+            t.stop()
+        assert math.isnan(t.mean)  # still inside the skip window
+        t.start()
+        t.stop()
+        assert t.mean >= 0 and not math.isnan(t.mean)
+
+    def test_stop_without_start_raises(self):
+        import pytest
+
+        from can_tpu.utils import StepTimer
+
+        t = StepTimer()
+        with pytest.raises(RuntimeError, match="before start"):
+            t.stop()
+        t.start()
+        t.stop()
+        with pytest.raises(RuntimeError, match="before start"):
+            t.stop()  # double-stop is the same misuse
+
+    def test_percentiles_and_shape_buckets(self):
+        from can_tpu.utils import StepTimer
+
+        t = StepTimer(skip_first=0)
+        assert t.percentiles()["n"] == 0
+        for i in range(10):
+            t.start()
+            t.stop(shape=(2, 8, 8, 3) if i % 2 else (2, 16, 8, 3))
+        p = t.percentiles()
+        assert p["n"] == 10
+        assert 0 < p["p50_s"] <= p["p95_s"] <= p["max_s"]
+        shapes = t.shape_summary()
+        assert set(shapes) == {"(2, 8, 8, 3)", "(2, 16, 8, 3)"}
+        assert all(rec["n"] == 5 for rec in shapes.values())
+
+    def test_drain_window_resets(self):
+        from can_tpu.utils import StepTimer
+
+        t = StepTimer(skip_first=0)
+        t.start()
+        t.stop()
+        assert len(t.drain_window()) == 1
+        assert t.drain_window() == []  # drained
+        assert t.percentiles()["n"] == 1  # reservoir keeps the sample
+
+
+class TestEmitNullResult:
+    def test_emits_valid_json_line(self, capsys):
+        """The watchdog null-result line is parsed by the driver — it must
+        be one json.loads-able line (satellite, this PR)."""
+        import json
+
+        from can_tpu.utils import emit_null_result
+
+        emit_null_result("bench_img_per_s", unit="images/sec",
+                         vs_baseline=None)()
+        out = capsys.readouterr().out.strip()
+        rec = json.loads(out)
+        assert rec["metric"] == "bench_img_per_s"
+        assert rec["value"] is None
+        assert "unreachable" in rec["error"]
+        assert rec["unit"] == "images/sec"
+
+    def test_extra_kwargs_ride_along(self, capsys):
+        import json
+
+        from can_tpu.utils import emit_null_result
+
+        emit_null_result("m", config={"batch": 16})()
+        assert json.loads(capsys.readouterr().out)["config"] == {"batch": 16}
 
 
 class TestStableRunId:

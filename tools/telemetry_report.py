@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL (or a --telemetry-dir of per-host files).
+
+One table per file: steps, p50/p95/max step time, recompiles + compile
+seconds, input-stall seconds, peak HBM / host RSS, heartbeat count.
+
+    python tools/telemetry_report.py runs/exp1/telemetry.host0.jsonl
+    python tools/telemetry_report.py runs/exp1/            # every host file
+    python tools/telemetry_report.py --json runs/exp1/telemetry.host0.jsonl
+
+Pure host-side file reading — no JAX import, safe on any machine the
+artifact was copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from can_tpu.obs.report import format_report, read_events, summarize  # noqa: E402
+
+
+def resolve_paths(target: str) -> list:
+    if os.path.isdir(target):
+        paths = sorted(glob.glob(os.path.join(target, "telemetry.host*.jsonl")))
+        if not paths:
+            raise SystemExit(f"no telemetry.host*.jsonl files in {target}")
+        return paths
+    if not os.path.isfile(target):
+        raise SystemExit(f"no such file or directory: {target}")
+    return [target]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("target", help="telemetry JSONL file, or a directory "
+                                  "holding telemetry.host*.jsonl")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary dict(s) as JSON instead of a table")
+    args = p.parse_args(argv)
+    for path in resolve_paths(args.target):
+        summary = summarize(read_events(path))
+        if args.json:
+            print(json.dumps({"path": path, **summary}))
+        else:
+            print(format_report(summary, title=path))
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
