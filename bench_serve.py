@@ -1,0 +1,206 @@
+"""Benchmark: online serving latency/throughput through can_tpu/serve.
+
+Drives the FULL serving stack (queue -> micro-batcher thread -> jitted
+engine) with mixed-resolution synthetic requests, two ways:
+
+* **closed loop** — K concurrent clients, each waiting for its result
+  before sending the next request: measures the stack's sustainable
+  throughput and the latency it gives cooperative clients.
+* **open loop** — Poisson arrivals at a target rate that does NOT slow
+  down when the service does (the real-traffic model): measures tail
+  latency under pressure and exercises the deadline + backpressure
+  rejection paths (a closed loop can never overload the queue, so it
+  never tests them).
+
+Emits ONE JSON report to ``BENCH_SERVE_<tag>.json`` and prints it; fields:
+per-phase p50/p95/p99 latency (ms), throughput (req/s), reject rate, plus
+mean batch fill, compile count vs bucket count, and the telemetry-derived
+event totals.  Config via env (defaults are CPU-smoke scale — one v5e chip
+serves far bigger shapes; override for real runs):
+
+    BENCH_SERVE_REQUESTS=96   requests per phase
+    BENCH_SERVE_CLIENTS=8     closed-loop concurrent clients
+    BENCH_SERVE_RATE=0        open-loop arrivals/s (0 = 2x measured
+                              closed-loop throughput, guaranteeing pressure)
+    BENCH_SERVE_MAX_BATCH=8   micro-batch size
+    BENCH_SERVE_MAX_WAIT_MS=5 flush deadline
+    BENCH_SERVE_DEADLINE_MS=2000  open-loop request deadline
+    BENCH_SERVE_SIZES=60x60,90x90,64x90,90x64   request resolutions
+    BENCH_SERVE_OUT=local     report tag
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _sizes_from_env() -> list:
+    spec = os.environ.get("BENCH_SERVE_SIZES", "60x60,90x90,64x90,90x64")
+    return [(int(h), int(w)) for h, w in
+            (part.split("x") for part in spec.split(","))]
+
+
+def _percentiles_ms(latencies_s: list) -> dict:
+    if not latencies_s:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None,
+                "max_ms": None}
+    arr = np.asarray(latencies_s, np.float64) * 1e3
+    return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "max_ms": round(float(arr.max()), 3)}
+
+
+def run_closed_loop(service, images, n_requests: int, n_clients: int) -> dict:
+    """K clients, each submit->wait->repeat; returns latency/throughput."""
+    from can_tpu.serve import RejectedError
+
+    latencies, rejects = [], [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    def client():
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= n_requests:
+                    return
+                counter[0] += 1
+            try:
+                res = service.predict(images[i % len(images)],
+                                      timeout=120.0)
+                with lock:
+                    latencies.append(res.latency_s)
+            except RejectedError:
+                with lock:
+                    rejects[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = len(latencies)
+    return {"requests": n_requests, "completed": done,
+            "rejected": rejects[0],
+            "reject_rate": round(rejects[0] / max(n_requests, 1), 4),
+            "throughput_rps": round(done / wall, 2),
+            "wall_s": round(wall, 3), **_percentiles_ms(latencies)}
+
+
+def run_open_loop(service, images, n_requests: int, rate_rps: float,
+                  deadline_ms: float, seed: int = 0) -> dict:
+    """Poisson arrivals at ``rate_rps``; every request carries a deadline.
+    Tickets are collected afterwards — arrival timing never blocks on
+    results, so the service feels true open-loop pressure."""
+    from can_tpu.serve import RejectedError
+
+    rng = np.random.default_rng(seed)
+    tickets = []
+    t0 = time.perf_counter()
+    next_t = 0.0
+    for i in range(n_requests):
+        next_t += float(rng.exponential(1.0 / rate_rps))
+        sleep = t0 + next_t - time.perf_counter()
+        if sleep > 0:
+            time.sleep(sleep)
+        tickets.append(service.submit(images[i % len(images)],
+                                      deadline_ms=deadline_ms))
+    latencies, rejects = [], 0
+    for t in tickets:
+        try:
+            latencies.append(t.result().latency_s)
+        except RejectedError:
+            rejects += 1
+    wall = time.perf_counter() - t0
+    return {"requests": n_requests, "completed": len(latencies),
+            "rejected": rejects,
+            "reject_rate": round(rejects / max(n_requests, 1), 4),
+            "offered_rps": round(rate_rps, 2),
+            "throughput_rps": round(len(latencies) / wall, 2),
+            "wall_s": round(wall, 3), **_percentiles_ms(latencies)}
+
+
+def main() -> None:
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "96"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "0"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "5"))
+    deadline_ms = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", "2000"))
+    tag = os.environ.get("BENCH_SERVE_OUT", "local")
+    sizes = _sizes_from_env()
+
+    import jax
+
+    from can_tpu.models import cannet_init
+    from can_tpu.obs import Telemetry
+    from can_tpu.serve import CountService, ServeEngine, prepare_image
+    from can_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache(None)  # no-op on CPU, warm restarts on TPU
+    # serving cost is weight-independent: random init serves the same
+    # FLOPs a trained checkpoint would (swap in cli/serve.py for accuracy)
+    params = cannet_init(jax.random.key(0))
+    telemetry = Telemetry()  # in-memory bus: engine compile attribution
+
+    ladder = (tuple(sorted({-(-h // 8) * 8 for h, _ in sizes})),
+              tuple(sorted({-(-w // 8) * 8 for _, w in sizes})))
+    buckets = [(h, w) for h in ladder[0] for w in ladder[1]]
+    engine = ServeEngine(params, telemetry=telemetry)
+    service = CountService(engine, max_batch=max_batch,
+                           max_wait_ms=max_wait_ms,
+                           queue_capacity=max(64, 4 * max_batch),
+                           high_water=max(48, 3 * max_batch),
+                           bucket_ladder=ladder, telemetry=telemetry)
+    t0 = time.perf_counter()
+    warm = service.warmup(buckets)
+
+    rng = np.random.default_rng(7)
+    images = [prepare_image(
+        (rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8))
+        for h, w in sizes]
+
+    with service:
+        closed = run_closed_loop(service, images, n_requests, n_clients)
+        if rate <= 0:
+            rate = 2.0 * max(closed["throughput_rps"], 1.0)
+        open_ = run_open_loop(service, images, n_requests, rate,
+                              deadline_ms)
+    stats = service.stats()
+
+    report = {
+        "metric": f"cannet_serve_b{max_batch}_w{int(max_wait_ms)}ms",
+        "unit": "ms latency / req_s",
+        "config": {"requests": n_requests, "clients": n_clients,
+                   "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+                   "deadline_ms": deadline_ms,
+                   "sizes": [f"{h}x{w}" for h, w in sizes],
+                   "buckets": [f"{h}x{w}" for h, w in buckets],
+                   "platform": jax.devices()[0].platform},
+        "warmup": warm,
+        "compile_count": engine.compile_count,
+        "bucket_count": len(buckets),
+        "compiles_bounded": engine.compile_count <= len(buckets),
+        "closed_loop": closed,
+        "open_loop": open_,
+        "mean_batch_fill": stats["mean_batch_fill"],
+        "batches": stats["batches"],
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    out = f"BENCH_SERVE_{tag}.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    print(f"[bench_serve] wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""Online serving CLI: load a checkpoint, warm up the bucket programs,
+answer count/density requests over HTTP.
+
+The reference repo has no request-level inference at all (test.py is batch
+evaluation of a directory); this is the front door the ROADMAP's
+"serves heavy traffic" north star needs.  Checkpoint loading — Orbax dir,
+reference ``.pth``, or converted ``.npz`` — is shared with the eval CLI
+(``cli/test.py::load_params``), so anything you can evaluate you can serve.
+
+    python -m can_tpu.cli.serve --torch-pth epoch_354.pth \
+        --bucket-shapes 384x512,512x768,768x1024 --max-batch 8 \
+        --max-wait-ms 5 --port 8000
+
+    curl -X POST --data-binary @img.npy \
+        'http://127.0.0.1:8000/predict?deadline_ms=200'
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+from typing import List, Tuple
+
+
+def parse_bucket_shapes(spec: str) -> List[Tuple[int, int]]:
+    """'384x512,512x768' -> [(384, 512), (512, 768)] (validated /8)."""
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.fullmatch(r"(\d+)x(\d+)", part)
+        if not m:
+            raise argparse.ArgumentTypeError(
+                f"bad bucket shape {part!r} (want HxW, e.g. 384x512)")
+        h, w = int(m.group(1)), int(m.group(2))
+        if h % 8 or w % 8:
+            raise argparse.ArgumentTypeError(
+                f"bucket shape {h}x{w} must be multiples of 8 (the "
+                f"density grid)")
+        shapes.append((h, w))
+    if not shapes:
+        raise argparse.ArgumentTypeError("no bucket shapes given")
+    return sorted(set(shapes))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="CANNet online serving")
+    # checkpoint source — same flags and conflict rules as the eval CLI
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="Orbax checkpoint dir (default ./checkpoints)")
+    p.add_argument("--epoch", type=int, default=None,
+                   help="checkpoint epoch (default: best by MAE, else latest)")
+    p.add_argument("--torch-pth", type=str, default="",
+                   help="serve a REFERENCE torch checkpoint directly")
+    p.add_argument("--params-npz", type=str, default="",
+                   help="serve a tools/import_torch_checkpoint.py .npz")
+    p.add_argument("--syncBN", action="store_true",
+                   help="checkpoint is the BatchNorm model variant")
+    p.add_argument("--seed", type=int, default=0)
+    # serving policy
+    p.add_argument("--bucket-shapes", type=parse_bucket_shapes,
+                   default=parse_bucket_shapes("384x512,512x768,768x1024"),
+                   help="comma-separated HxW bucket ladder; requests snap "
+                        "UP to the smallest covering shape per axis — one "
+                        "XLA program each, all compiled at startup")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="requests per micro-batch (every launch pads to "
+                        "exactly this, so batch size is static)")
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="longest a request waits for batch-mates before "
+                        "its partial batch launches")
+    p.add_argument("--queue-capacity", type=int, default=64,
+                   help="hard bound on queued requests (beyond: queue_full)")
+    p.add_argument("--high-water", type=int, default=None,
+                   help="queue depth that starts load shedding "
+                        "(backpressure rejects until half-drained); "
+                        "default: 3/4 of capacity")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline (expired requests "
+                        "are rejected, never dispatched); requests may "
+                        "override per call")
+    p.add_argument("--bf16", action="store_true",
+                   help="bf16 compute (MXU rate; counts shift ~1e-3 "
+                        "relative vs the f32 parity path)")
+    p.add_argument("--u8-warmup", action="store_true",
+                   help="also pre-compile uint8-input programs, for "
+                        "clients POSTing ?raw=1 (pixels stay bytes on the "
+                        "wire and into HBM; normalise-on-device, like the "
+                        "train CLI's --u8-input)")
+    # front end
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    # plumbing shared with the other CLIs
+    p.add_argument("--platform", type=str, default="default",
+                   choices=["default", "cpu", "tpu"])
+    p.add_argument("--compile-cache", type=str, default="auto",
+                   help="persistent XLA compilation-cache dir ('auto' = "
+                        "~/.cache/can_tpu/xla, 'off' disables) — makes "
+                        "warm restarts deserialise the bucket programs "
+                        "instead of recompiling")
+    p.add_argument("--telemetry-dir", type=str, default="",
+                   help="write serve.request/serve.batch/serve.reject "
+                        "JSONL here (tools/telemetry_report.py summarises)")
+    p.add_argument("--telemetry-heartbeat-s", type=float, default=60.0)
+    return p.parse_args(argv)
+
+
+def build_service(args, telemetry=None):
+    """Engine + service from parsed args (no networking) — the seam the
+    tests and bench drive; ``main`` adds HTTP around it."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from can_tpu.cli.test import load_params
+    from can_tpu.serve import CountService, ServeEngine
+
+    params, batch_stats = load_params(args)
+    engine = ServeEngine(params, batch_stats,
+                         compute_dtype=jnp.bfloat16 if args.bf16 else None,
+                         telemetry=telemetry)
+    high_water = (args.high_water if args.high_water is not None
+                  else max(1, (3 * args.queue_capacity) // 4))
+    shapes = args.bucket_shapes
+    ladder = (tuple(sorted({h for h, _ in shapes})),
+              tuple(sorted({w for _, w in shapes})))
+    service = CountService(engine, max_batch=args.max_batch,
+                           max_wait_ms=args.max_wait_ms,
+                           queue_capacity=args.queue_capacity,
+                           high_water=high_water,
+                           default_deadline_ms=args.deadline_ms,
+                           bucket_ladder=ladder, telemetry=telemetry)
+    # the ladder's cross product is the compile universe; warm it ALL so
+    # no live request ever pays a compile
+    grid = [(h, w) for h in ladder[0] for w in ladder[1]]
+    dtypes = (np.float32, np.uint8) if args.u8_warmup else (np.float32,)
+    report = service.warmup(grid, dtypes=dtypes)
+    print(f"[serve] warmup: {report['compiles']} programs over "
+          f"{report['shapes']} bucket shapes in {report['seconds']:.1f}s")
+    return service
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from can_tpu.cli.test import validate_params_source
+
+    validate_params_source(args)  # the corrected sentinel logic, shared
+    from can_tpu.cli.train import (
+        apply_compile_cache,
+        apply_platform,
+        build_telemetry,
+    )
+    from can_tpu.parallel import init_runtime, process_index, shutdown_runtime
+    from can_tpu.serve import serve_http
+
+    apply_platform(args)
+    init_runtime()
+    apply_compile_cache(args, announce=True)
+    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
+                                           trace_window=None)
+    try:
+        service = build_service(args, telemetry=telemetry)
+        with service:
+            httpd = serve_http(service, host=args.host, port=args.port)
+            print(f"[serve] listening on http://{args.host}:{args.port} "
+                  f"(POST /predict, GET /healthz, GET /stats)")
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:
+                print("[serve] shutting down")
+            finally:
+                httpd.server_close()
+        return 0
+    finally:
+        if heartbeat is not None:
+            heartbeat.close()
+        telemetry.close()
+        shutdown_runtime()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -46,7 +46,12 @@ def parse_args(argv=None):
                         "pair with --gt-root")
     p.add_argument("--gt-root", type=str, default="")
     p.add_argument("--split", type=str, default="test", choices=["train", "test"])
-    p.add_argument("--checkpoint-dir", type=str, default="./checkpoints")
+    # default=None sentinel, resolved to ./checkpoints AFTER conflict
+    # checks: the --torch-pth conflict must key on "flag was provided",
+    # not on the literal default string (ADVICE r5 — an explicit
+    # `--checkpoint-dir ./checkpoints` used to slip through)
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="Orbax checkpoint dir (default ./checkpoints)")
     p.add_argument("--epoch", type=int, default=None,
                    help="checkpoint epoch (default: best by MAE, else latest)")
     p.add_argument("--torch-pth", type=str, default="",
@@ -115,6 +120,34 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def validate_params_source(args) -> None:
+    """Reject conflicting/invalid checkpoint-source flags, then resolve the
+    ``--checkpoint-dir`` default.  Shared by the eval and serve CLIs (both
+    load params through :func:`load_params`); pure arg validation — safe
+    to run before any runtime init."""
+    import os as _os
+
+    if args.torch_pth and args.params_npz:
+        raise SystemExit("give --torch-pth OR --params-npz, not both")
+    if (args.torch_pth or args.params_npz) and args.syncBN:
+        raise SystemExit("--torch-pth/--params-npz hold the reference "
+                         "model (no BatchNorm); drop --syncBN")
+    # imported params are a complete model: checkpoint-selection flags
+    # would be silently ignored, so reject them like the conflicts above
+    if (args.torch_pth or args.params_npz) and args.epoch is not None:
+        raise SystemExit("--epoch selects an Orbax checkpoint epoch; it "
+                         "does not apply to --torch-pth/--params-npz")
+    if (args.torch_pth or args.params_npz) \
+            and args.checkpoint_dir is not None:
+        raise SystemExit("--checkpoint-dir is ignored with "
+                         "--torch-pth/--params-npz; drop one of them")
+    for p in (args.torch_pth, args.params_npz):
+        if p and not _os.path.isfile(p):
+            raise SystemExit(f"no such checkpoint file: {p}")
+    if args.checkpoint_dir is None:
+        args.checkpoint_dir = "./checkpoints"
+
+
 def load_params(args):
     """Restore (params, batch_stats) from the checkpoint manager (best epoch
     by default), or import reference/converted weights directly."""
@@ -151,25 +184,7 @@ def main(argv=None) -> int:
     img_root, gt_root = resolve_split_roots(
         args.split, args.image_root, args.gt_root, args.data_root,
         flag_stem="")
-    import os as _os
-
-    if args.torch_pth and args.params_npz:
-        raise SystemExit("give --torch-pth OR --params-npz, not both")
-    if (args.torch_pth or args.params_npz) and args.syncBN:
-        raise SystemExit("--torch-pth/--params-npz hold the reference "
-                         "model (no BatchNorm); drop --syncBN")
-    # imported params are a complete model: checkpoint-selection flags
-    # would be silently ignored, so reject them like the conflicts above
-    if (args.torch_pth or args.params_npz) and args.epoch is not None:
-        raise SystemExit("--epoch selects an Orbax checkpoint epoch; it "
-                         "does not apply to --torch-pth/--params-npz")
-    if (args.torch_pth or args.params_npz) \
-            and args.checkpoint_dir != "./checkpoints":
-        raise SystemExit("--checkpoint-dir is ignored with "
-                         "--torch-pth/--params-npz; drop one of them")
-    for p in (args.torch_pth, args.params_npz):
-        if p and not _os.path.isfile(p):
-            raise SystemExit(f"no such checkpoint file: {p}")
+    validate_params_source(args)
     from can_tpu.cli.train import (
         apply_compile_cache,
         apply_platform,

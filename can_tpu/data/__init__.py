@@ -1,6 +1,6 @@
 from .density import gaussian_density_map, generate_density_maps
 from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD, normalize_host
-from .batching import ShardedBatcher, Batch, pad_batch
+from .batching import ShardedBatcher, Batch, pad_batch, snap_to_bucket
 from .synthetic import make_synthetic_dataset
 from .prefetch import PrefetchPutError, prefetch_to_device
 
@@ -14,6 +14,7 @@ __all__ = [
     "ShardedBatcher",
     "Batch",
     "pad_batch",
+    "snap_to_bucket",
     "make_synthetic_dataset",
     "prefetch_to_device",
     "PrefetchPutError",

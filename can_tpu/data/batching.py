@@ -69,6 +69,33 @@ def _ceil_bound(v: int, bounds: Tuple[int, ...]) -> int:
     return bounds[-1]
 
 
+def snap_to_bucket(hw: Tuple[int, int], *,
+                   ladder: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None,
+                   pad_multiple: Optional[Tuple[int, int]] = None,
+                   min_bucket_h: Optional[int] = None) -> Tuple[int, int]:
+    """Bucket (H, W) for one snapped item shape — the single source of the
+    shape→bucket mapping, shared by the offline ``ShardedBatcher`` and the
+    online ``serve`` micro-batcher so both paths pad identically.
+
+    ladder: per-axis upper bounds ((H bounds), (W bounds)) — each axis snaps
+    up to its smallest covering bound (items above the top bound get the top
+    bound; callers size the ladder from their shape distribution).
+    pad_multiple: (mh, mw) round-up multiples, used when no ladder is given.
+    Neither -> exact shape (zero padding).
+    """
+    if ladder is not None:
+        hb, wb = ladder
+        key = (_ceil_bound(hw[0], hb), _ceil_bound(hw[1], wb))
+    elif pad_multiple is not None:
+        mh, mw = pad_multiple
+        key = (math.ceil(hw[0] / mh) * mh, math.ceil(hw[1] / mw) * mw)
+    else:
+        key = hw
+    if min_bucket_h is not None and key[0] < min_bucket_h:
+        key = (min_bucket_h, key[1])
+    return key
+
+
 def _merge_partial_groups(partials, gbs: int):
     """Improvement-only pairwise merging of partial batch groups.
 
@@ -408,17 +435,9 @@ class ShardedBatcher:
         return len(self.dataset)
 
     def _bucket_key(self, hw: Tuple[int, int]) -> Tuple[int, int]:
-        if self.bucket_ladder is not None:
-            hb, wb = self.bucket_ladder
-            key = (_ceil_bound(hw[0], hb), _ceil_bound(hw[1], wb))
-        elif self.pad_multiple is None:
-            key = hw
-        else:
-            mh, mw = self.pad_multiple
-            key = (math.ceil(hw[0] / mh) * mh, math.ceil(hw[1] / mw) * mw)
-        if self.min_bucket_h is not None and key[0] < self.min_bucket_h:
-            key = (self.min_bucket_h, key[1])
-        return key
+        return snap_to_bucket(hw, ladder=self.bucket_ladder,
+                              pad_multiple=self.pad_multiple,
+                              min_bucket_h=self.min_bucket_h)
 
     def _remnant_menu(self) -> Tuple[int, ...]:
         """Legal sub-batch sizes (global units), descending: the full global

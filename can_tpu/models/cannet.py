@@ -331,12 +331,22 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
             if axes:
                 s0 = jax.lax.psum(s0, axes)
                 s1 = jax.lax.psum(s1, axes)
-            mean = s1 / s0
+            # s0 floored at 1: an all-fill batch (every slot a dead
+            # remnant slot) has zero valid pixels, and 0/0 moments would
+            # NaN the whole output — the floor yields mean=var=0 instead,
+            # and the zero mask already erases the slots downstream
+            # (ADVICE r5)
+            den = jnp.maximum(s0, 1.0)
+            mean = s1 / den
             ss = jnp.sum(jnp.square(yf - mean) * m, axis=(0, 1, 2))
             if axes:
                 ss = jax.lax.psum(ss, axes)
-            var = ss / s0
+            var = ss / den
             unbiased = var * (s0 / jnp.maximum(s0 - 1.0, 1.0))
+            # an all-fill batch must also leave the RUNNING stats alone:
+            # blending its mean=var=0 into the EMA would drag the stats
+            # toward zero by one momentum step per occurrence
+            momentum = momentum * jnp.where(s0 > 0.0, 1.0, 0.0)
         elif axes:
             # two-pass global moments over the mesh: mean first, then the
             # centered second moment (stabler than E[x^2] - E[x]^2)

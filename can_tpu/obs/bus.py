@@ -37,9 +37,14 @@ from typing import Optional
 import numpy as np
 
 # the kinds the acceptance contract and tools/telemetry_report.py know;
-# informational — emit() accepts any kind string
+# informational — emit() accepts any kind string.  serve.* kinds come from
+# the online serving subsystem (can_tpu/serve): per-request completions,
+# per-flush micro-batches (carrying the queue-depth gauge), and typed
+# rejections.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
-               "epoch", "bench", "run")
+               "epoch", "bench", "run",
+               "serve.request", "serve.batch", "serve.reject",
+               "serve.warmup")
 
 
 def _jsonable(v):

@@ -53,6 +53,12 @@ def summarize(events: Iterable[dict]) -> dict:
     last_ts = None
     last_heartbeat_ts = None
     epochs = set()
+    serve_lat: List[float] = []
+    serve_rejects: dict = {}
+    serve_batches = 0
+    serve_slots = 0
+    serve_valid = 0
+    serve_queue_depth_max = None
     for e in events:
         kind = e.get("kind", "?")
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -87,6 +93,23 @@ def summarize(events: Iterable[dict]) -> dict:
         elif kind == "epoch":
             if e.get("step") is not None:
                 epochs.add(int(e["step"]))
+        elif kind == "serve.request":
+            if "latency_s" in p:
+                serve_lat.append(float(p["latency_s"]))
+        elif kind == "serve.batch":
+            serve_batches += 1
+            serve_slots += int(p.get("size", 0))
+            serve_valid += int(p.get("valid", 0))
+            depth = p.get("queue_depth")
+            if depth is not None:
+                d = int(depth)
+                serve_queue_depth_max = (
+                    d if serve_queue_depth_max is None
+                    else max(serve_queue_depth_max, d))
+        elif kind == "serve.reject":
+            reason = str(p.get("reason", "?"))
+            serve_rejects[reason] = (serve_rejects.get(reason, 0)
+                                     + int(p.get("count", 1)))
     wall_s = (last_ts - first_ts) if first_ts is not None else None
     return {
         "events": len(events),
@@ -106,6 +129,17 @@ def summarize(events: Iterable[dict]) -> dict:
         "peak_host_rss_mb": peak_rss_mb,
         "heartbeats": by_kind.get("heartbeat", 0),
         "last_heartbeat_ts": last_heartbeat_ts,
+        # online serving (can_tpu/serve); zeros/Nones for offline runs
+        "serve_requests": by_kind.get("serve.request", 0),
+        "serve_latency_p50_s": _percentile(serve_lat, 50),
+        "serve_latency_p95_s": _percentile(serve_lat, 95),
+        "serve_latency_max_s": max(serve_lat) if serve_lat else None,
+        "serve_batches": serve_batches,
+        "serve_mean_fill": (round(serve_valid / serve_slots, 4)
+                            if serve_slots else None),
+        "serve_rejects": sum(serve_rejects.values()),
+        "serve_rejects_by_reason": dict(sorted(serve_rejects.items())),
+        "serve_queue_depth_max": serve_queue_depth_max,
     }
 
 
@@ -140,6 +174,19 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
         ("peak host RSS", _fmt(summary["peak_host_rss_mb"], " MB")),
         ("heartbeats", _fmt(summary["heartbeats"])),
     ]
+    if summary.get("serve_requests") or summary.get("serve_rejects"):
+        rejects = summary.get("serve_rejects_by_reason") or {}
+        rows += [
+            ("serve requests", _fmt(summary["serve_requests"])),
+            ("serve p50", _fmt(summary["serve_latency_p50_s"], " s")),
+            ("serve p95", _fmt(summary["serve_latency_p95_s"], " s")),
+            ("serve max", _fmt(summary["serve_latency_max_s"], " s")),
+            ("serve batches", _fmt(summary["serve_batches"])),
+            ("serve mean fill", _fmt(summary["serve_mean_fill"])),
+            ("serve rejects", " ".join(f"{k}={n}"
+                                       for k, n in rejects.items()) or "0"),
+            ("serve queue max", _fmt(summary["serve_queue_depth_max"])),
+        ]
     width = max(len(k) for k, _ in rows)
     lines = [f"# {title}"]
     lines += [f"{k.ljust(width)}  {v}" for k, v in rows]

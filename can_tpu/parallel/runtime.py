@@ -36,12 +36,28 @@ _initialized = False
 
 def _multihost_metadata_present() -> bool:
     """True only when pod metadata names MORE THAN ONE worker — a single
-    hostname (e.g. a tunnelled dev chip) is not a pod."""
+    hostname (e.g. a tunnelled dev chip) is not a pod.
+
+    A bare coordinator var is NOT such a signal on its own: dev machines
+    inherit stale ``JAX_COORDINATOR_ADDRESS`` / ``MEGASCALE_*`` env from
+    old pod sessions, and treating it as pod metadata routed them into the
+    fatal split-brain branch below (ADVICE r5).  The coordinator var only
+    counts when an accompanying worker-count variable says > 1 worker;
+    otherwise this host degrades to single-process like any other
+    coordinator-less run."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
     if ("JAX_COORDINATOR_ADDRESS" in os.environ
             or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ):
-        return True
-    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-    return len([h for h in hosts.split(",") if h.strip()]) > 1
+        for var in ("NUM_PROCESSES", "JAX_NUM_PROCESSES",
+                    "TPU_WORKER_COUNT", "MEGASCALE_NUM_SLICES"):
+            try:
+                if int(os.environ.get(var, "")) > 1:
+                    return True
+            except ValueError:
+                continue
+    return False
 
 
 def init_runtime(*, coordinator_address: Optional[str] = None,

@@ -1,0 +1,64 @@
+"""can_tpu.serve — online inference: bucketed micro-batching, deadlines,
+backpressure.
+
+The training repro already solved variable-resolution-under-XLA once
+(``data/batching.py``); this subsystem lifts that solution to request
+granularity::
+
+    engine = ServeEngine(params, batch_stats)
+    ladder = ((384, 768), (512, 1024))      # per-axis H x W bounds
+    svc = CountService(engine, max_batch=8, max_wait_ms=5,
+                       queue_capacity=64, high_water=48,
+                       bucket_ladder=ladder)
+    # compile BEFORE traffic — the ladder's full cross product, because
+    # any (H bound, W bound) pairing can occur
+    svc.warmup([(h, w) for h in ladder[0] for w in ladder[1]])
+    with svc:                               # starts the batcher thread
+        res = svc.predict(prepare_image(img), deadline_ms=200)
+        print(res.count, res.latency_s)
+
+Guarantees: every submitted request resolves or is rejected with a typed
+reason (never hangs); compile count == distinct (bucket, dtype) programs,
+all paid in ``warmup``; a served count is bit-for-bit what ``evaluate()``
+computes offline for the same image and params.
+"""
+
+from .batcher import MicroBatcher
+from .engine import ServeEngine
+from .queue import (
+    REJECT_BACKPRESSURE,
+    REJECT_DEADLINE,
+    REJECT_ERROR,
+    REJECT_QUEUE_FULL,
+    REJECT_SHUTDOWN,
+    BoundedRequestQueue,
+    RejectedError,
+    ServeRequest,
+    ServeResult,
+)
+from .service import (
+    CountService,
+    ServeTicket,
+    make_http_handler,
+    prepare_image,
+    serve_http,
+)
+
+__all__ = [
+    "BoundedRequestQueue",
+    "CountService",
+    "MicroBatcher",
+    "REJECT_BACKPRESSURE",
+    "REJECT_DEADLINE",
+    "REJECT_ERROR",
+    "REJECT_QUEUE_FULL",
+    "REJECT_SHUTDOWN",
+    "RejectedError",
+    "ServeEngine",
+    "ServeRequest",
+    "ServeResult",
+    "ServeTicket",
+    "make_http_handler",
+    "prepare_image",
+    "serve_http",
+]

@@ -1,0 +1,234 @@
+"""Micro-batcher: group queued requests by bucket shape, flush as one
+static-shape batch.
+
+The offline ``ShardedBatcher`` solves variable-resolution-under-XLA with
+shape buckets + masked padding; online serving has the same constraint at
+request granularity, so this batcher reuses the SAME math — the bucket
+mapping is ``data.batching.snap_to_bucket`` and batch assembly is
+``data.batching.pad_batch`` — it only swaps the epoch schedule for an
+arrival-driven flush policy:
+
+* a bucket's group flushes the moment it holds ``max_batch`` requests
+  (the batch is full — waiting longer buys nothing);
+* otherwise a group flushes once its OLDEST request has waited
+  ``max_wait_ms`` (bounded latency cost for batching: an idle service adds
+  at most max_wait to any request);
+* every flush pads to exactly ``max_batch`` slots (fill slots are
+  ``sample_mask=0``, precisely the offline dead-slot convention), so each
+  bucket shape is ONE static (B, H, W) signature — the XLA compile count
+  is the distinct-bucket count, independent of traffic.
+
+Requests whose deadline expires before dispatch are rejected, never
+launched: a result the client has already given up on still costs a full
+batch slot, and under overload those zombie slots are exactly the capacity
+the live requests need.
+
+Single consumer thread; dispatch runs ON that thread — the device executes
+serially anyway, and one thread means the pending-group state needs no
+locking beyond the queue's own.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from can_tpu.data.batching import Batch, pad_batch, snap_to_bucket
+from can_tpu.serve.queue import (
+    REJECT_DEADLINE,
+    REJECT_ERROR,
+    BoundedRequestQueue,
+    ServeRequest,
+)
+
+# (bucket H, bucket W, image dtype): dtype is part of the jit signature, so
+# u8 and f32 requests must not share a batch buffer (pad_batch keeps the
+# items' dtype)
+GroupKey = Tuple[int, int, str]
+
+
+class MicroBatcher:
+    """Pulls from a ``BoundedRequestQueue``, emits padded ``Batch``es.
+
+    dispatch: ``fn(bucket_hw, batch, requests)`` — executes the batch and
+    resolves each request (the service wires this to the engine).  A
+    dispatch that raises rejects its requests with ``error`` and the
+    batcher keeps running: one poison batch must not kill the service.
+
+    bucket_ladder / pad_multiple / min_bucket_h: forwarded to
+    ``snap_to_bucket`` (same semantics as the offline batcher).
+    """
+
+    def __init__(self, queue: BoundedRequestQueue, dispatch: Callable,
+                 *, max_batch: int = 8, max_wait_ms: float = 5.0,
+                 bucket_ladder=None, pad_multiple=None,
+                 min_bucket_h: Optional[int] = None, ds: int = 8,
+                 telemetry=None, clock=time.monotonic,
+                 idle_wait_s: float = 0.05,
+                 on_reject: Optional[Callable] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.queue = queue
+        self.dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        if isinstance(pad_multiple, int):
+            pad_multiple = (pad_multiple, pad_multiple)
+        self.bucket_ladder = bucket_ladder
+        self.pad_multiple = pad_multiple
+        self.min_bucket_h = min_bucket_h
+        self.ds = int(ds)
+        self.telemetry = telemetry
+        # on_reject(reason, count): batcher-side rejections (deadline
+        # expiry, poison batch) happen past the admission gate, so the
+        # owner's reject counters need this hook to stay truthful
+        self.on_reject = on_reject
+        self._clock = clock
+        self._idle_wait_s = float(idle_wait_s)
+        # group key -> (requests, oldest enqueue ts)
+        self._pending: Dict[GroupKey, Tuple[List[ServeRequest], float]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- bucket mapping -------------------------------------------------
+    def bucket_of(self, hw: Tuple[int, int]) -> Tuple[int, int]:
+        return snap_to_bucket(hw, ladder=self.bucket_ladder,
+                              pad_multiple=self.pad_multiple,
+                              min_bucket_h=self.min_bucket_h)
+
+    # -- core pump (thread-free, testable with a fake clock) ------------
+    def run_once(self, wait_s: Optional[float] = None) -> int:
+        """One pump iteration: wait for arrivals (bounded by the earliest
+        pending flush deadline), intake, flush what's due.  Returns the
+        number of batches dispatched."""
+        wait = self._idle_wait_s if wait_s is None else wait_s
+        if self._pending:
+            due = min(t0 + self.max_wait_s
+                      for _, t0 in self._pending.values())
+            wait = max(0.0, min(wait, due - self._clock()))
+        self.queue.wait_nonempty(wait)
+        n = self.intake()
+        return n + self.poll(self._clock())
+
+    def intake(self) -> int:
+        """Drain the queue into per-bucket pending groups; reject already
+        expired requests; flush any group that reaches ``max_batch``.
+        Returns batches dispatched."""
+        live, expired = self.queue.drain()
+        for r in expired:
+            self._reject_expired(r)
+        flushed = 0
+        for r in live:
+            bh, bw = self.bucket_of(r.shape)
+            key = (bh, bw, str(r.image.dtype))
+            group, t0 = self._pending.get(key, ([], r.t_submit))
+            group.append(r)
+            self._pending[key] = (group, t0)
+            if len(group) >= self.max_batch:
+                del self._pending[key]
+                self._flush(key, group)
+                flushed += 1
+        return flushed
+
+    def poll(self, now: float) -> int:
+        """Reject expired pending requests; flush groups whose oldest
+        request has waited ``max_wait_ms``.  Returns batches dispatched."""
+        flushed = 0
+        for key in sorted(self._pending):
+            group, t0 = self._pending[key]
+            kept = []
+            for r in group:
+                if r.expired(now):
+                    self._reject_expired(r)
+                else:
+                    kept.append(r)
+            if not kept:
+                del self._pending[key]
+                continue
+            if now - t0 >= self.max_wait_s:
+                del self._pending[key]
+                self._flush(key, kept)
+                flushed += 1
+            elif len(kept) != len(group):
+                self._pending[key] = (kept, t0)
+        return flushed
+
+    def flush_all(self) -> int:
+        """Dispatch every pending group (shutdown path: an admitted request
+        resolves even when the service is closing)."""
+        n = 0
+        for key in sorted(self._pending):
+            group, _ = self._pending.pop(key)
+            self._flush(key, group)
+            n += 1
+        return n
+
+    def pending_count(self) -> int:
+        return sum(len(g) for g, _ in self._pending.values())
+
+    # -- assembly + dispatch --------------------------------------------
+    def _flush(self, key: GroupKey, group: List[ServeRequest]) -> None:
+        bh, bw = key[0], key[1]
+        try:
+            # zero per-item density targets: serve batches reuse the
+            # offline Batch layout (image/dmap/pixel_mask/sample_mask) so
+            # the engine can run the exact eval-step math; dmap is unused
+            # by prediction
+            items = [(r.image,
+                      np.zeros((r.shape[0] // self.ds,
+                                r.shape[1] // self.ds, 1), np.float32))
+                     for r in group]
+            batch = pad_batch(items, (bh, bw), self.max_batch,
+                              [True] * len(group), self.ds)
+            self.dispatch((bh, bw), batch, group)
+        except Exception as e:  # noqa: BLE001 — poison batch, keep serving
+            n = 0
+            for r in group:
+                if not r.done:
+                    r.reject(REJECT_ERROR, f"{type(e).__name__}: {e}")
+                    n += 1
+            if self.on_reject is not None and n:
+                self.on_reject(REJECT_ERROR, n)
+            if self.telemetry is not None:
+                self.telemetry.emit("serve.reject", reason=REJECT_ERROR,
+                                    count=n,
+                                    detail=f"{type(e).__name__}: {e}")
+
+    def _reject_expired(self, r: ServeRequest) -> None:
+        r.reject(REJECT_DEADLINE, "deadline expired before dispatch")
+        if self.on_reject is not None:
+            self.on_reject(REJECT_DEADLINE, 1)
+        if self.telemetry is not None:
+            self.telemetry.emit("serve.reject", reason=REJECT_DEADLINE,
+                                count=1, request_id=r.id)
+
+    # -- thread lifecycle ------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            raise RuntimeError("batcher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="can-tpu-serve-batcher")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+        # drain-on-stop: admitted requests still resolve (close() has
+        # already stopped new admissions)
+        self.intake()
+        self.flush_all()
+
+    def close(self) -> None:
+        """Stop the pump thread and flush everything pending (idempotent)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        else:
+            self.intake()
+            self.flush_all()

@@ -1,0 +1,135 @@
+"""ServeEngine: params + one jitted predict program per bucket signature.
+
+The prediction math is EXACTLY the offline eval step's (``train/steps.py
+make_eval_step``): normalise-on-device for u8 batches, ``cannet_apply``
+forward, masked per-image count reduction via ``train.loss.density_counts``
+— so a count served online is bit-for-bit the count ``evaluate()`` would
+have produced for the same image and params (the offline/online parity the
+tests pin).  The engine adds only what serving needs around that math:
+
+* params (and BN ``batch_stats``) are device-resident from construction —
+  a host-numpy param tree fed to jit would re-upload ~74 MB per batch;
+* ``warmup()`` drives one zero batch through every bucket shape BEFORE
+  traffic, so no real request pays the multi-second trace+compile bill,
+  and ``utils/compile_cache`` (wired by the CLI) makes warm restarts
+  deserialise instead of recompile;
+* every new (shape, dtype) signature is counted and attributed on the
+  telemetry bus via ``obs.RecompileTracker`` — a mid-traffic compile is a
+  latency cliff an operator must be able to see.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from can_tpu.data.batching import Batch, pad_batch
+from can_tpu.models import cannet_apply
+from can_tpu.obs import RecompileTracker, Telemetry
+from can_tpu.train.loss import density_counts
+from can_tpu.train.steps import _batch_image
+
+
+def _batch_dict(batch: Batch) -> dict:
+    return {"image": batch.image, "dmap": batch.dmap,
+            "pixel_mask": batch.pixel_mask,
+            "sample_mask": batch.sample_mask}
+
+
+class ServeEngine:
+    """Executes padded serve batches on the local device.
+
+    params / batch_stats: as returned by ``cli.test.load_params`` (host or
+    device trees; moved on-device once here).
+    compute_dtype: jnp.bfloat16 for MXU-rate serving, None for f32 parity.
+    telemetry: optional bus for ``compile`` events; the engine works (and
+    still counts compiles) without one.
+    """
+
+    def __init__(self, params, batch_stats=None, *, compute_dtype=None,
+                 ds: int = 8, telemetry=None):
+        self.ds = int(ds)
+        self.params = jax.device_put(params)
+        self.batch_stats = (None if batch_stats is None
+                            else jax.device_put(batch_stats))
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+
+        def predict(params, batch, batch_stats):
+            image = _batch_image(batch)  # u8 -> normalised f32, f32 passthru
+            if batch_stats is not None:
+                pred = cannet_apply(params, image,
+                                    compute_dtype=compute_dtype,
+                                    batch_stats=batch_stats, train=False)
+            else:
+                pred = cannet_apply(params, image,
+                                    compute_dtype=compute_dtype)
+            counts, _ = density_counts(pred, batch)
+            mask = (batch["pixel_mask"]
+                    * batch["sample_mask"][:, None, None, None])
+            return counts, pred.astype(jnp.float32) * mask
+
+        # RecompileTracker attributes each new (shape, dtype) signature —
+        # bucket warmup and any mid-traffic compile both land as `compile`
+        # events, and len(signatures) is the engine's compile count
+        self._predict = RecompileTracker(jax.jit(predict), self.telemetry,
+                                         name="serve_predict", batch_arg=1)
+        self._signatures = self.telemetry.signature_registry["serve_predict"]
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct predict signatures compiled so far."""
+        return len(self._signatures)
+
+    def predict_batch(self, batch: Batch, *, want_density: bool = False
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Run one padded batch; returns host (counts (B,), density
+        (B, h, w, 1) or None).  Counts are fetched synchronously (the
+        caller resolves waiting requests with them, nothing to overlap
+        with); the density tensor — orders of magnitude bigger — is only
+        shipped device→host when a request actually asked for it.  The
+        compiled program is identical either way: only the host fetch is
+        conditional, so the jit signature (and the warmup compile budget)
+        doesn't fork on ``want_density``."""
+        counts, density = self._predict(self.params, _batch_dict(batch),
+                                        self.batch_stats)
+        return (np.asarray(counts),
+                np.asarray(density) if want_density else None)
+
+    @property
+    def last_batch_compiled(self) -> bool:
+        """True when the most recent ``predict_batch`` hit a new signature
+        (its wall time is compile, not steady-state — keep it out of
+        latency reservoirs, exactly like the offline loops do)."""
+        return self._predict.last_first_call
+
+    def warmup(self, bucket_shapes, max_batch: int, *,
+               dtypes=(np.float32,)) -> dict:
+        """Compile every (bucket shape, dtype) program before traffic.
+
+        bucket_shapes: iterable of (H, W); dtypes: the image dtypes traffic
+        will carry (float32, and uint8 if the front end admits raw bytes).
+        Returns ``{"shapes": n, "compiles": new, "seconds": wall}``.
+        """
+        t0 = time.perf_counter()
+        before = self.compile_count
+        shapes = sorted(set(map(tuple, bucket_shapes)))
+        for bh, bw in shapes:
+            if bh % self.ds or bw % self.ds:
+                raise ValueError(f"bucket shape {bh}x{bw} is not a multiple "
+                                 f"of the density downsample ({self.ds})")
+            for dt in dtypes:
+                img = np.zeros((bh, bw, 3), dt)
+                dm = np.zeros((bh // self.ds, bw // self.ds, 1), np.float32)
+                batch = pad_batch([(img, dm)], (bh, bw), max_batch,
+                                  [False], self.ds)
+                self.predict_batch(batch)  # np.asarray fetch = fence
+        dt_s = time.perf_counter() - t0
+        report = {"shapes": len(shapes),
+                  "compiles": self.compile_count - before,
+                  "seconds": round(dt_s, 3)}
+        self.telemetry.emit("serve.warmup", **report)
+        return report

@@ -1,0 +1,401 @@
+"""CountService: the serving front door (programmatic API + HTTP).
+
+Wires the pieces into one lifecycle::
+
+    client -> submit() -> BoundedRequestQueue -> MicroBatcher(thread)
+                                                   -> ServeEngine.predict_batch
+                                                   -> resolve ServeRequests
+
+``submit()/result()`` is the primary API — tests and the bench drive the
+full stack through it with zero networking.  The HTTP front end
+(``serve_http``) is a thin stdlib adapter over the same calls: one process,
+one device owner, many client connections.
+
+Telemetry (same bus/schema as train/eval, summarised by
+``tools/telemetry_report.py``):
+
+* ``serve.request``  — per completed request: latency_s, bucket, ok
+* ``serve.batch``    — per flush: bucket, size/valid/fill, execute_s,
+                       queue_depth (the depth gauge rides the batch event:
+                       sampled exactly when it changes, no extra thread)
+* ``serve.reject``   — per rejection: reason (queue_full / backpressure /
+                       deadline / shutdown / error)
+* ``serve.warmup``   — pre-traffic compile pass summary
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from can_tpu.data.dataset import normalize_host
+from can_tpu.serve.batcher import MicroBatcher
+from can_tpu.serve.engine import ServeEngine
+from can_tpu.serve.queue import (
+    REJECT_SHUTDOWN,
+    BoundedRequestQueue,
+    RejectedError,
+    ServeRequest,
+    ServeResult,
+)
+from can_tpu.utils.profiling import StepTimer
+
+
+def prepare_image(image: np.ndarray, *, ds: int = 8,
+                  normalize: bool = True) -> np.ndarray:
+    """Snap an arbitrary HWC image to the density grid, exactly as the
+    offline ``CrowdDataset.__getitem__`` does: cv2 bilinear resize down to
+    the nearest /ds multiple (half-pixel centers — bit-exact with the
+    reference), then ImageNet-normalise (u8 input + normalize=False keeps
+    bytes for the device-normalised transfer mode)."""
+    if image.ndim != 3 or image.shape[2] != 3:
+        raise ValueError(f"expected HWC RGB image, got shape {image.shape}")
+    h, w = image.shape[:2]
+    rows, cols = h // ds, w // ds
+    if rows == 0 or cols == 0:
+        raise ValueError(f"image {h}x{w} is smaller than one {ds}px "
+                         f"density cell")
+    if (rows * ds, cols * ds) != (h, w):
+        import cv2
+
+        image = cv2.resize(np.ascontiguousarray(image), (cols * ds, rows * ds))
+    if normalize:
+        image = normalize_host(np.asarray(image))
+        if image.dtype != np.float32:
+            raise ValueError("normalize=True needs uint8 or already "
+                             f"normalised float32 pixels, got {image.dtype}")
+    return image
+
+
+class ServeTicket:
+    """Handle returned by ``submit()``; ``result()`` blocks for the
+    outcome (raising ``RejectedError`` on any rejection — never hangs:
+    the wait is bounded by the request deadline plus a grace window for
+    the in-flight batch)."""
+
+    def __init__(self, request: ServeRequest, service: "CountService"):
+        self._request = request
+        self._service = service
+        self.id = request.id
+
+    @property
+    def done(self) -> bool:
+        return self._request.done
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if timeout is None:
+            if self._request.deadline_ts is not None:
+                # deadline + a grace window: an expired request is rejected
+                # at the next batcher pump, and a dispatched one resolves
+                # within the batch execute — either way well under this.
+                # "now" comes from the SERVICE clock (deadline_ts does too;
+                # mixing in time.monotonic breaks fake-clock tests)
+                timeout = (self._request.deadline_ts
+                           - self._service._clock()
+                           + self._service.grace_s)
+            else:
+                timeout = self._service.default_result_timeout_s
+        return self._request.wait(max(timeout, 0.0))
+
+
+class CountService:
+    """Owns the queue, the batcher thread, and the engine.
+
+    bucket_ladder / pad_multiple: the bucket policy (same semantics as the
+    offline batcher; pick the ladder from the deployment's expected shape
+    distribution).  ``warmup()`` should be called before traffic.
+    """
+
+    def __init__(self, engine: ServeEngine, *, max_batch: int = 8,
+                 max_wait_ms: float = 5.0, queue_capacity: int = 64,
+                 high_water: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 bucket_ladder=None, pad_multiple=None,
+                 min_bucket_h: Optional[int] = None,
+                 telemetry=None, clock=time.monotonic):
+        self.engine = engine
+        self.telemetry = telemetry if telemetry is not None else engine.telemetry
+        self.max_batch = int(max_batch)
+        self.default_deadline_s = (None if default_deadline_ms is None
+                                   else float(default_deadline_ms) / 1e3)
+        # result() safety margins (see ServeTicket)
+        self.grace_s = max(1.0, 4 * float(max_wait_ms) / 1e3)
+        self.default_result_timeout_s = 120.0
+        self._clock = clock
+        self.queue = BoundedRequestQueue(queue_capacity,
+                                         high_water=high_water, clock=clock)
+        self.batcher = MicroBatcher(self.queue, self._dispatch,
+                                    max_batch=max_batch,
+                                    max_wait_ms=max_wait_ms,
+                                    bucket_ladder=bucket_ladder,
+                                    pad_multiple=pad_multiple,
+                                    min_bucket_h=min_bucket_h,
+                                    ds=engine.ds, telemetry=self.telemetry,
+                                    clock=clock,
+                                    on_reject=self._note_reject)
+        # request latency reservoir: p50/p95/max over recent requests,
+        # tagged by bucket shape (skip_first=0 — warmup() already keeps
+        # compiles off the request path, so every sample is steady-state).
+        # Guarded by _lock: the batcher thread records while HTTP threads
+        # read percentiles, and a deque mutated mid-iteration raises.
+        self.latency = StepTimer(skip_first=0)
+        self._lock = threading.Lock()
+        self._stats = {"submitted": 0, "completed": 0, "rejected": 0,
+                       "batches": 0, "batch_slots": 0, "batch_valid": 0}
+        self._started = False
+        self._closed = False
+        # image dtypes warmup() has compiled — the HTTP raw=1 gate: an
+        # unwarmed dtype would compile for seconds ON the batcher thread,
+        # stalling every bucket's flushes mid-traffic
+        self.warmed_dtypes: set = set()
+
+    # -- lifecycle -------------------------------------------------------
+    def warmup(self, bucket_shapes: Sequence[Tuple[int, int]],
+               dtypes=(np.float32,)) -> dict:
+        report = self.engine.warmup(bucket_shapes, self.max_batch,
+                                    dtypes=dtypes)
+        self.warmed_dtypes.update(np.dtype(dt) for dt in dtypes)
+        return report
+
+    def start(self) -> "CountService":
+        if not self._started:
+            self.batcher.start()
+            self._started = True
+        return self
+
+    def close(self) -> None:
+        """Stop admissions, drain in-flight work, reject the rest."""
+        if self._closed:
+            return
+        self._closed = True
+        for r in self.queue.close():
+            r.reject(REJECT_SHUTDOWN, "service closing")
+            self._count_reject(REJECT_SHUTDOWN)
+        self.batcher.close()  # flushes pending groups through the engine
+        self._started = False
+
+    def __enter__(self) -> "CountService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the programmatic API --------------------------------------------
+    def submit(self, image: np.ndarray, *,
+               deadline_ms: Optional[float] = None,
+               want_density: bool = False) -> ServeTicket:
+        """Enqueue one prepared image (see ``prepare_image``).  Returns a
+        ticket whose ``result()`` either yields a ``ServeResult`` or raises
+        ``RejectedError`` — immediate rejection (full queue, shedding,
+        shutdown) still returns a ticket, with the rejection stored."""
+        deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
+                      else self.default_deadline_s)
+        req = ServeRequest(np.asarray(image), deadline_s=deadline_s,
+                           want_density=want_density, clock=self._clock)
+        if req.shape[0] % self.engine.ds or req.shape[1] % self.engine.ds:
+            raise ValueError(
+                f"image shape {req.shape} is not snapped to the /"
+                f"{self.engine.ds} density grid — call prepare_image first")
+        bucket = self.batcher.bucket_of(req.shape)
+        if bucket[0] < req.shape[0] or bucket[1] < req.shape[1]:
+            # above the top ladder bound the snap goes DOWN, and the batch
+            # assembly would raise — poisoning every co-batched request.
+            # Reject the oversized image at the door instead (client error)
+            raise ValueError(
+                f"image {req.shape[0]}x{req.shape[1]} exceeds the largest "
+                f"bucket {bucket[0]}x{bucket[1]} — resize it or serve with "
+                f"a bigger bucket ladder")
+        with self._lock:
+            self._stats["submitted"] += 1
+        if self._closed:
+            req.reject(REJECT_SHUTDOWN, "service closed")
+            self._count_reject(REJECT_SHUTDOWN)
+            return ServeTicket(req, self)
+        reason = self.queue.offer(req)
+        if reason is not None:
+            self._count_reject(reason)
+        return ServeTicket(req, self)
+
+    def predict(self, image: np.ndarray, *,
+                deadline_ms: Optional[float] = None,
+                want_density: bool = False,
+                timeout: Optional[float] = None) -> ServeResult:
+        """submit + result in one call (the closed-loop client pattern)."""
+        return self.submit(image, deadline_ms=deadline_ms,
+                           want_density=want_density).result(timeout)
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = dict(self._stats)
+            lat = self.latency.percentiles()
+        slots = max(s["batch_slots"], 1)
+        return {
+            **s,
+            "queue_depth": self.queue.depth(),
+            "shedding": self.queue.shedding,
+            "mean_batch_fill": round(s["batch_valid"] / slots, 4),
+            "latency_p50_s": lat["p50_s"],
+            "latency_p95_s": lat["p95_s"],
+            "latency_max_s": lat["max_s"],
+            "compile_count": self.engine.compile_count,
+        }
+
+    # -- batcher dispatch (runs on the batcher thread) -------------------
+    def _dispatch(self, bucket_hw, batch, requests) -> None:
+        t0 = time.perf_counter()
+        counts, density = self.engine.predict_batch(
+            batch, want_density=any(r.want_density for r in requests))
+        execute_s = time.perf_counter() - t0
+        fill = len(requests) / batch.image.shape[0]
+        now = self._clock()
+        for slot, req in enumerate(requests):
+            h, w = req.shape
+            dens = (np.asarray(density[slot, : h // self.engine.ds,
+                                       : w // self.engine.ds])
+                    if req.want_density else None)
+            latency = now - req.t_submit
+            req.resolve(ServeResult(count=float(counts[slot]), density=dens,
+                                    bucket_hw=tuple(bucket_hw),
+                                    batch_fill=fill, latency_s=latency))
+            with self._lock:
+                self.latency.record(latency, shape=tuple(bucket_hw))
+            self.telemetry.emit("serve.request", request_id=req.id,
+                               latency_s=round(latency, 6),
+                               bucket=list(bucket_hw), ok=True)
+        with self._lock:
+            self._stats["completed"] += len(requests)
+            self._stats["batches"] += 1
+            self._stats["batch_slots"] += batch.image.shape[0]
+            self._stats["batch_valid"] += len(requests)
+        self.telemetry.emit("serve.batch", bucket=list(bucket_hw),
+                           size=batch.image.shape[0], valid=len(requests),
+                           fill=round(fill, 4),
+                           execute_s=round(execute_s, 6),
+                           compiled=self.engine.last_batch_compiled,
+                           queue_depth=self.queue.depth())
+
+    def _note_reject(self, reason: str, count: int = 1) -> None:
+        """Count a rejection that already emitted its own telemetry
+        (batcher-side deadline/error paths) — stats() must agree with the
+        RejectedErrors clients actually saw."""
+        with self._lock:
+            self._stats["rejected"] += count
+
+    def _count_reject(self, reason: str) -> None:
+        self._note_reject(reason)
+        self.telemetry.emit("serve.reject", reason=reason, count=1,
+                           queue_depth=self.queue.depth())
+
+
+# -- HTTP front end -----------------------------------------------------
+def make_http_handler(service: CountService):
+    """Request handler class bound to ``service``.
+
+    POST /predict    body: .npy bytes (np.save of an HWC uint8/float32
+                     image); query: ?deadline_ms=&density=1&raw=1
+                     (raw=1 keeps uint8 pixels and normalises ON DEVICE —
+                     the u8 transfer mode; needs the u8 programs warmed,
+                     cli --u8-warmup)
+                     -> 200 {"count", "latency_ms", "bucket", "batch_fill"
+                             [, "density"]}
+                     -> 408/503 {"error", "reason"} on deadline/shedding
+    GET  /healthz    -> 200 {"ok": true}
+    GET  /stats      -> 200 stats() JSON
+    """
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    from can_tpu.serve.queue import (
+        REJECT_BACKPRESSURE,
+        REJECT_DEADLINE,
+        REJECT_QUEUE_FULL,
+    )
+
+    status_of = {REJECT_DEADLINE: 408, REJECT_QUEUE_FULL: 503,
+                 REJECT_BACKPRESSURE: 503, REJECT_SHUTDOWN: 503}
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet: telemetry is the log
+            pass
+
+        def do_GET(self):
+            path = urlparse(self.path).path
+            if path == "/healthz":
+                self._send(200, {"ok": True})
+            elif path == "/stats":
+                self._send(200, service.stats())
+            else:
+                self._send(404, {"error": f"no such path: {path}"})
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            if url.path != "/predict":
+                self._send(404, {"error": f"no such path: {url.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                arr = np.load(io.BytesIO(self.rfile.read(n)),
+                              allow_pickle=False)
+                q = parse_qs(url.query)
+                deadline_ms = (float(q["deadline_ms"][0])
+                               if "deadline_ms" in q else None)
+                want_density = q.get("density", ["0"])[0] not in ("0", "")
+                raw = q.get("raw", ["0"])[0] not in ("0", "")
+                if raw and arr.dtype != np.uint8:
+                    raise ValueError("raw=1 needs uint8 pixels")
+                if raw and np.dtype(np.uint8) not in service.warmed_dtypes:
+                    # an unwarmed dtype would compile mid-traffic on the
+                    # batcher thread, stalling every bucket — refuse at
+                    # the door (serve with --u8-warmup to enable)
+                    raise ValueError("raw=1 (uint8) programs are not "
+                                     "warmed on this server; start it "
+                                     "with --u8-warmup")
+                image = prepare_image(arr, ds=service.engine.ds,
+                                      normalize=not raw)
+            except Exception as e:  # noqa: BLE001 — client error, not ours
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                res = service.predict(image, deadline_ms=deadline_ms,
+                                      want_density=want_density)
+            except ValueError as e:  # submit-side validation: client error
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            except RejectedError as e:
+                self._send(status_of.get(e.reason, 503),
+                           {"error": str(e), "reason": e.reason})
+                return
+            payload = {"count": res.count,
+                       "latency_ms": round(res.latency_s * 1e3, 3),
+                       "bucket": list(res.bucket_hw),
+                       "batch_fill": res.batch_fill}
+            if res.density is not None:
+                payload["density"] = res.density[..., 0].tolist()
+            self._send(200, payload)
+
+    return Handler
+
+
+def serve_http(service: CountService, *, host: str = "127.0.0.1",
+               port: int = 8000):
+    """Build a ``ThreadingHTTPServer`` for ``service`` (caller runs
+    ``serve_forever()``; threads give one blocked client per connection
+    while the single batcher thread owns the device)."""
+    from http.server import ThreadingHTTPServer
+
+    return ThreadingHTTPServer((host, port), make_http_handler(service))

@@ -152,6 +152,13 @@ class StepTimer:
         self._last = None
         if not record:
             return dt
+        return self.record(dt, shape=shape)
+
+    def record(self, dt: float, *, shape=None) -> float:
+        """Record an externally measured sample — for durations that don't
+        fit the sequential start/stop pattern (e.g. serve request
+        latencies, measured per request across threads).  Same reservoir,
+        window, skip_first, and per-shape accounting as ``stop``."""
         self._count += 1
         if self._count > self.skip_first:
             self._total += dt

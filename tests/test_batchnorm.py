@@ -84,6 +84,42 @@ class TestBatchNormOp:
                                    rtol=1e-4, atol=1e-5)
 
 
+class TestMaskedMomentsAllFill:
+    def test_all_fill_batch_yields_zeros_not_nan(self):
+        """ADVICE r5: with a zero mask (every slot a dead remnant slot)
+        the weighted moments were 0/0 -> NaN, poisoning params through
+        the running stats.  The s0 floor must yield finite zeros."""
+        rng = np.random.default_rng(2)
+        y = jnp.asarray(rng.normal(size=(2, 4, 4, 3)).astype(np.float32))
+        bn = {"scale": jnp.ones((3,)), "bias": jnp.zeros((3,))}
+        stats = {"mean": jnp.full((3,), 1.5), "var": jnp.full((3,), 2.0)}
+        mask = jnp.zeros((2, 4, 4, 1))
+        out, updated = _batch_norm(y, bn, stats, train=True, momentum=0.1,
+                                   mask=mask)
+        assert np.isfinite(np.asarray(out)).all()
+        # and the RUNNING stats must be untouched: blending the batch's
+        # degenerate mean=var=0 would drag them toward zero by one
+        # momentum step per all-fill batch (review r6)
+        np.testing.assert_array_equal(np.asarray(updated["mean"]),
+                                      np.full(3, 1.5, np.float32))
+        np.testing.assert_array_equal(np.asarray(updated["var"]),
+                                      np.full(3, 2.0, np.float32))
+
+    def test_partial_mask_unchanged_by_guard(self):
+        """The floor must not perturb the normal masked path."""
+        rng = np.random.default_rng(3)
+        y = jnp.asarray(rng.normal(size=(2, 4, 4, 3)).astype(np.float32))
+        bn = {"scale": jnp.ones((3,)), "bias": jnp.zeros((3,))}
+        mask = np.ones((2, 4, 4, 1), np.float32)
+        mask[1] = 0.0  # second item is a fill slot
+        out, updated = _batch_norm(y, bn, None, train=True, momentum=0.1,
+                                   mask=jnp.asarray(mask))
+        # moments must equal the unmasked moments of the valid half
+        ref_mean = np.asarray(y[:1]).mean(axis=(0, 1, 2))
+        np.testing.assert_allclose(np.asarray(updated["mean"]), ref_mean,
+                                   rtol=1e-5, atol=1e-6)
+
+
 class TestBNModel:
     def test_plain_model_has_no_bn(self):
         params = cannet_init(jax.random.key(0))

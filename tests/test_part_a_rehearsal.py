@@ -9,9 +9,39 @@ the (scaled) Part-A shape histogram -> best-MAE checkpoint -> eval CLI.
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow
+
+class TestConvergenceGate:
+    """tools/rehearse_part_a.py's success gate as pure logic (tier-1)."""
+
+    def _v(self, maes, zero_mae=10.0, eval_rc=0, eval_mae=1.0):
+        from tools.rehearse_part_a import convergence_verdict
+
+        return convergence_verdict(maes, zero_mae, eval_rc, eval_mae)
+
+    def test_improving_run_passes(self):
+        assert self._v([5.0, 4.0, 3.5])["ok"]
+
+    def test_flat_at_floor_passes(self):
+        assert self._v([5.0, 5.1, 5.05])["ok"]
+
+    def test_improve_then_diverge_fails_on_tail(self):
+        """ADVICE r5: an epoch-1 dip used to satisfy `improved` and pass a
+        run whose MAE then climbed without bound."""
+        v = self._v([5.0, 4.0, 30.0])
+        assert not v["tail_ok"] and not v["ok"]
+
+    def test_monotone_divergence_fails(self):
+        assert not self._v([5.0, 7.0, 9.0])["ok"]
+
+    def test_never_learned_fails_even_if_flat(self):
+        assert not self._v([5.0, 5.0, 5.0], zero_mae=5.0)["ok"]
+
+    def test_broken_eval_chain_fails(self):
+        assert not self._v([5.0, 4.0, 4.0], eval_rc=1)["ok"]
+        assert not self._v([5.0, 4.0, 4.0], eval_mae=float("nan"))["ok"]
 
 
+@pytest.mark.slow
 def test_recipe_chain_executes_and_improves(tmp_path):
     from tools.rehearse_part_a import run
 

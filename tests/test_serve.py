@@ -1,0 +1,672 @@
+"""Online serving subsystem (can_tpu/serve): queue, batcher, engine,
+service, HTTP, telemetry.
+
+The contract under test (ISSUE 2 acceptance):
+
+* every submitted request RESOLVES or is REJECTED with a typed reason —
+  never hangs;
+* XLA compile count == distinct (bucket, dtype) programs, all paid in
+  warmup, none during traffic;
+* a served count is bit-for-bit what ``evaluate()`` computes offline for
+  the same image and params (offline/online parity);
+* flush policy: full batch flushes immediately, partial batches flush at
+  max_wait, buckets never mix shapes or dtypes;
+* backpressure sheds load with hysteresis; deadlines reject, not zombify.
+"""
+
+import io
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from can_tpu import obs
+from can_tpu.data import (
+    CrowdDataset,
+    ShardedBatcher,
+    make_synthetic_dataset,
+    snap_to_bucket,
+)
+from can_tpu.models import cannet_init
+from can_tpu.serve import (
+    REJECT_BACKPRESSURE,
+    REJECT_DEADLINE,
+    REJECT_ERROR,
+    REJECT_QUEUE_FULL,
+    REJECT_SHUTDOWN,
+    BoundedRequestQueue,
+    CountService,
+    MicroBatcher,
+    RejectedError,
+    ServeEngine,
+    ServeRequest,
+    prepare_image,
+    serve_http,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def req(h=64, w=64, deadline_s=None, clock=None, dtype=np.float32):
+    img = np.zeros((h, w, 3), dtype)
+    return ServeRequest(img, deadline_s=deadline_s,
+                        clock=clock or (lambda: 0.0))
+
+
+class TestQueue:
+    def test_fifo_admit_and_drain(self):
+        q = BoundedRequestQueue(4)
+        rs = [req(), req()]
+        assert all(q.offer(r) is None for r in rs)
+        assert q.depth() == 2
+        live, expired = q.drain()
+        assert live == rs and expired == []
+        assert q.depth() == 0
+
+    def test_capacity_rejects_queue_full(self):
+        q = BoundedRequestQueue(2)
+        assert q.offer(req()) is None
+        assert q.offer(req()) is None
+        r = req()
+        assert q.offer(r) == REJECT_QUEUE_FULL
+        assert r.done
+        with pytest.raises(RejectedError) as e:
+            r.wait(0)
+        assert e.value.reason == REJECT_QUEUE_FULL
+
+    def test_backpressure_hysteresis_on_outstanding(self):
+        """Shedding keys on OUTSTANDING (admitted, unresolved) requests —
+        draining the waiting queue into the batcher must NOT end it; only
+        resolutions drain load, and shedding persists until the low_water
+        band (no admit/timeout oscillation at the mark)."""
+        from can_tpu.serve import ServeResult
+
+        q = BoundedRequestQueue(16, high_water=4, low_water=2)
+        admitted = [req() for _ in range(4)]
+        for r in admitted:
+            assert q.offer(r) is None
+        assert q.outstanding() == 4
+        assert q.offer(req()) == REJECT_BACKPRESSURE
+        assert q.shedding
+        # the batcher empties the queue — load is unchanged, still shed
+        live, _ = q.drain()
+        assert len(live) == 4 and q.depth() == 0
+        assert q.shedding
+        assert q.offer(req()) == REJECT_BACKPRESSURE
+        # one resolution: outstanding 3 > low_water 2 — still shedding
+        res = ServeResult(count=0.0, density=None, bucket_hw=(64, 64),
+                          batch_fill=1.0, latency_s=0.0)
+        admitted[0].resolve(res)
+        assert q.outstanding() == 3
+        assert q.offer(req()) == REJECT_BACKPRESSURE
+        # down to the band: recovered
+        admitted[1].resolve(res)
+        assert q.outstanding() == 2
+        assert not q.shedding
+        assert q.offer(req()) is None
+
+    def test_drain_splits_expired(self):
+        clock = FakeClock()
+        q = BoundedRequestQueue(8, clock=clock)
+        fresh = req(deadline_s=10.0, clock=clock)
+        stale = req(deadline_s=0.5, clock=clock)
+        q.offer(fresh)
+        q.offer(stale)
+        clock.t = 1.0
+        live, expired = q.drain()
+        assert live == [fresh] and expired == [stale]
+
+    def test_close_stops_admission(self):
+        q = BoundedRequestQueue(4)
+        q.offer(req())
+        leftovers = q.close()
+        assert len(leftovers) == 1
+        r = req()
+        assert q.offer(r) == REJECT_SHUTDOWN
+
+    def test_wait_timeout_is_typed_not_hang(self):
+        r = req()
+        with pytest.raises(RejectedError):
+            r.wait(0.01)
+
+
+class CollectDispatch:
+    """Records flushed (bucket, batch, requests) and resolves requests."""
+
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def __call__(self, bucket_hw, batch, requests):
+        if self.fail:
+            raise RuntimeError("boom")
+        self.calls.append((bucket_hw, batch, requests))
+        from can_tpu.serve import ServeResult
+
+        for r in requests:
+            r.resolve(ServeResult(count=0.0, density=None,
+                                  bucket_hw=bucket_hw, batch_fill=0.0,
+                                  latency_s=0.0))
+
+
+class TestBatcherFlush:
+    """Flush-trigger matrix with a fake clock and no device work."""
+
+    def make(self, dispatch, *, max_batch=4, max_wait_ms=100.0, ladder=None):
+        clock = FakeClock()
+        q = BoundedRequestQueue(64, clock=clock)
+        b = MicroBatcher(q, dispatch, max_batch=max_batch,
+                         max_wait_ms=max_wait_ms, bucket_ladder=ladder,
+                         clock=clock)
+        return q, b, clock
+
+    def test_flush_on_max_batch_is_immediate(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=3)
+        for _ in range(3):
+            q.offer(req(64, 64, clock=clock))
+        assert b.intake() == 1  # no clock advance needed
+        (bucket, batch, requests), = d.calls
+        assert bucket == (64, 64)
+        assert batch.image.shape == (3, 64, 64, 3)
+        assert batch.sample_mask.tolist() == [1.0, 1.0, 1.0]
+
+    def test_partial_batch_waits_then_flushes_on_max_wait(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=4, max_wait_ms=100.0)
+        q.offer(req(64, 64, clock=clock))
+        q.offer(req(64, 64, clock=clock))
+        b.intake()
+        assert b.poll(clock.t) == 0 and not d.calls  # not due yet
+        clock.t = 0.099
+        assert b.poll(clock.t) == 0
+        clock.t = 0.1
+        assert b.poll(clock.t) == 1
+        (_, batch, requests), = d.calls
+        # static shape: padded to max_batch with dead fill slots
+        assert batch.image.shape == (4, 64, 64, 3)
+        assert batch.sample_mask.tolist() == [1.0, 1.0, 0.0, 0.0]
+        assert len(requests) == 2
+
+    def test_mixed_buckets_group_independently(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=2,
+                                ladder=((64, 96), (64, 96)))
+        q.offer(req(64, 64, clock=clock))
+        q.offer(req(96, 96, clock=clock))
+        q.offer(req(60, 60, clock=clock))  # snaps up into (64, 64)
+        assert b.intake() == 1  # the (64,64) pair filled; (96,96) waits
+        assert d.calls[0][0] == (64, 64)
+        assert b.pending_count() == 1
+        clock.t = 1.0
+        assert b.poll(clock.t) == 1
+        assert d.calls[1][0] == (96, 96)
+
+    def test_dtype_never_mixes_in_one_batch(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=2)
+        q.offer(req(64, 64, clock=clock, dtype=np.float32))
+        q.offer(req(64, 64, clock=clock, dtype=np.uint8))
+        b.intake()
+        assert not d.calls  # same bucket shape, but two dtype groups of 1
+        clock.t = 1.0
+        assert b.poll(clock.t) == 2
+        dtypes = {c[1].image.dtype for c in d.calls}
+        assert dtypes == {np.dtype(np.float32), np.dtype(np.uint8)}
+
+    def test_expired_request_rejected_never_dispatched(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=2, max_wait_ms=50.0)
+        doomed = req(64, 64, deadline_s=0.01, clock=clock)
+        q.offer(doomed)
+        b.intake()
+        clock.t = 0.02  # past deadline, before max_wait
+        assert b.poll(clock.t) == 0
+        assert doomed.done and not d.calls
+        with pytest.raises(RejectedError) as e:
+            doomed.wait(0)
+        assert e.value.reason == REJECT_DEADLINE
+
+    def test_dispatch_error_rejects_requests_keeps_batcher(self):
+        d = CollectDispatch(fail=True)
+        q, b, clock = self.make(d, max_batch=1)
+        r = req(64, 64, clock=clock)
+        q.offer(r)
+        b.intake()  # dispatch raises inside; batcher survives
+        with pytest.raises(RejectedError) as e:
+            r.wait(0)
+        assert e.value.reason == REJECT_ERROR
+        d.fail = False
+        d2 = req(64, 64, clock=clock)
+        q.offer(d2)
+        b.intake()
+        assert d2.done and not isinstance(d2._reject, RejectedError)
+
+    def test_bucket_mapping_matches_offline_batcher(self):
+        """The serve bucket function IS the offline one (snap_to_bucket):
+        same ladder -> same cell for every shape."""
+        ladder = ((64, 128), (96, 160))
+        b = MicroBatcher(BoundedRequestQueue(4), lambda *a: None,
+                         bucket_ladder=ladder)
+        for hw in [(64, 96), (65, 96), (128, 160), (200, 300), (8, 8)]:
+            assert b.bucket_of(hw) == snap_to_bucket(hw, ladder=ladder)
+
+    def test_flush_all_drains_pending(self):
+        d = CollectDispatch()
+        q, b, clock = self.make(d, max_batch=8)
+        q.offer(req(64, 64, clock=clock))
+        q.offer(req(96, 96, clock=clock))
+        b.intake()
+        assert b.flush_all() == 2
+        assert b.pending_count() == 0
+
+
+@pytest.fixture(scope="module")
+def small_engine():
+    params = cannet_init(jax.random.key(0))
+    tel = obs.Telemetry()
+    return ServeEngine(params, telemetry=tel)
+
+
+class TestEngineAndService:
+    def test_warmup_compiles_once_per_bucket(self, small_engine):
+        before = small_engine.compile_count
+        rep = small_engine.warmup([(64, 64), (64, 96)], max_batch=2)
+        assert small_engine.compile_count - before == rep["compiles"]
+        # idempotent: a second warmup compiles nothing new
+        rep2 = small_engine.warmup([(64, 64), (64, 96)], max_batch=2)
+        assert rep2["compiles"] == 0
+
+    def test_smoke_64_mixed_requests_bounded_compiles(self, small_engine):
+        """Acceptance: >= 64 mixed-resolution requests, zero hangs, compile
+        count bounded by the distinct bucket shapes, fill/latency stats."""
+        ladder = ((64, 96), (64, 96))
+        svc = CountService(small_engine, max_batch=4, max_wait_ms=2.0,
+                           queue_capacity=256,
+                           bucket_ladder=ladder)
+        rep = svc.warmup([(h, w) for h in ladder[0] for w in ladder[1]])
+        # compile bound: one program per distinct bucket shape (engine is
+        # module-scoped, so compare this warmup's DELTA, not the total)
+        assert rep["compiles"] <= 4
+        compiles_before_traffic = small_engine.compile_count
+        sizes = [(64, 64), (96, 96), (64, 96), (96, 64), (60, 60), (90, 90)]
+        rng = np.random.default_rng(0)
+        with svc:
+            tickets = [
+                svc.submit(prepare_image(
+                    (rng.uniform(0, 1, s + (3,)) * 255).astype(np.uint8)),
+                    deadline_ms=60_000)
+                for s in (sizes[i % len(sizes)] for i in range(64))]
+            results = [t.result(timeout=120.0) for t in tickets]
+        assert len(results) == 64  # every request resolved — no hangs
+        # no NEW compiles during traffic: warmup paid them all
+        assert small_engine.compile_count == compiles_before_traffic
+        buckets = {r.bucket_hw for r in results}
+        assert buckets <= {(64, 64), (64, 96), (96, 64), (96, 96)}
+        st = svc.stats()
+        assert st["completed"] == 64 and st["rejected"] == 0
+        assert 0 < st["mean_batch_fill"] <= 1.0
+        assert st["latency_p50_s"] > 0
+
+    def test_deadline_zero_is_rejected_not_hung(self, small_engine):
+        svc = CountService(small_engine, max_batch=2, max_wait_ms=5.0,
+                           bucket_ladder=((64,), (64,)))
+        with svc:
+            t = svc.submit(np.zeros((64, 64, 3), np.float32),
+                           deadline_ms=0.0)
+            with pytest.raises(RejectedError) as e:
+                t.result(timeout=10.0)
+        assert e.value.reason == REJECT_DEADLINE
+        # batcher-side rejections count in stats() too (review r6): the
+        # operator-facing reject counter must agree with what clients saw
+        assert svc.stats()["rejected"] == 1
+
+    def test_submit_after_close_rejects_shutdown(self, small_engine):
+        svc = CountService(small_engine, max_batch=1,
+                           bucket_ladder=((64,), (64,)))
+        svc.start()
+        svc.close()
+        t = svc.submit(np.zeros((64, 64, 3), np.float32))
+        with pytest.raises(RejectedError) as e:
+            t.result(timeout=1.0)
+        assert e.value.reason == REJECT_SHUTDOWN
+
+    def test_unsnapped_image_rejected_at_submit(self, small_engine):
+        svc = CountService(small_engine, max_batch=1,
+                           bucket_ladder=((64,), (64,)))
+        with pytest.raises(ValueError):
+            svc.submit(np.zeros((60, 60, 3), np.float32))
+
+    def test_oversized_image_rejected_at_submit_not_poisoning(
+            self, small_engine):
+        """Above the top ladder bound the snap goes DOWN; without the
+        door check the batch assembly would raise and error-reject every
+        co-batched request (review r6)."""
+        svc = CountService(small_engine, max_batch=1,
+                           bucket_ladder=((64,), (64,)))
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            svc.submit(np.zeros((128, 128, 3), np.float32))
+        # and over HTTP it's a 400 client error, not a 503
+        svc2 = CountService(small_engine, max_batch=2, max_wait_ms=2.0,
+                            bucket_ladder=((64,), (64,)))
+        with svc2:
+            httpd = serve_http(svc2, port=0)
+            port = httpd.server_address[1]
+            thread = threading.Thread(target=httpd.serve_forever,
+                                      daemon=True)
+            thread.start()
+            try:
+                buf = io.BytesIO()
+                np.save(buf, np.zeros((128, 128, 3), np.uint8))
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict",
+                    data=buf.getvalue(), method="POST")
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(r)
+                assert e.value.code == 400
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+
+    def test_want_density_returns_item_sized_map(self, small_engine):
+        svc = CountService(small_engine, max_batch=2, max_wait_ms=2.0,
+                           bucket_ladder=((96,), (96,)))
+        svc.warmup([(96, 96)])
+        with svc:
+            res = svc.predict(np.zeros((64, 72, 3), np.float32),
+                              want_density=True, timeout=60.0)
+        assert res.bucket_hw == (96, 96)
+        assert res.density.shape == (8, 9, 1)  # item's grid, crop of bucket
+
+    def test_http_raw_without_u8_warmup_is_400(self, small_engine):
+        """raw=1 on a server that never warmed uint8 programs must be
+        refused at the door — an unwarmed dtype would compile mid-traffic
+        on the batcher thread, stalling every bucket (review r6)."""
+        svc = CountService(small_engine, max_batch=2, max_wait_ms=2.0,
+                           bucket_ladder=((64,), (64,)))
+        svc.warmup([(64, 64)])  # float32 only
+        with svc:
+            httpd = serve_http(svc, port=0)
+            port = httpd.server_address[1]
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            try:
+                buf = io.BytesIO()
+                np.save(buf, np.zeros((64, 64, 3), np.uint8))
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict?raw=1",
+                    data=buf.getvalue(), method="POST")
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(r)
+                assert e.value.code == 400
+                assert "u8-warmup" in json.loads(e.value.read())["error"]
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+
+    def test_http_round_trip(self, small_engine):
+        svc = CountService(small_engine, max_batch=2, max_wait_ms=2.0,
+                           bucket_ladder=((64,), (64,)))
+        svc.warmup([(64, 64)], dtypes=(np.float32, np.uint8))
+        with svc:
+            httpd = serve_http(svc, port=0)
+            port = httpd.server_address[1]
+            thread = threading.Thread(target=httpd.serve_forever,
+                                      daemon=True)
+            thread.start()
+            try:
+                img = np.zeros((60, 60, 3), np.uint8)
+                buf = io.BytesIO()
+                np.save(buf, img)
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict?deadline_ms=60000",
+                    data=buf.getvalue(), method="POST")
+                payload = json.loads(urllib.request.urlopen(r).read())
+                assert payload["bucket"] == [64, 64]
+                assert "count" in payload and "latency_ms" in payload
+                health = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz").read())
+                assert health == {"ok": True}
+                stats = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats").read())
+                assert stats["completed"] >= 1
+                # raw=1: uint8 stays uint8 on the wire and into the
+                # engine (device normalisation) — must hit the u8 program
+                # warmed above, not compile a new one
+                compiles = small_engine.compile_count
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict?raw=1"
+                    f"&deadline_ms=60000",
+                    data=buf.getvalue(), method="POST")
+                payload = json.loads(urllib.request.urlopen(r).read())
+                assert payload["bucket"] == [64, 64]
+                assert small_engine.compile_count == compiles
+                # raw=1 with non-u8 payload is a client error, not a 500
+                fbuf = io.BytesIO()
+                np.save(fbuf, np.zeros((60, 60, 3), np.float32))
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict?raw=1",
+                    data=fbuf.getvalue(), method="POST")
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(r)
+                assert e.value.code == 400
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+
+
+class TestOfflineOnlineParity:
+    """Acceptance: a served count is bit-for-bit evaluate()'s per-image
+    output for the same image and params."""
+
+    @pytest.fixture(scope="class")
+    def setup(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("serve_parity")
+        img_root, gt_root = make_synthetic_dataset(
+            str(root), 5, sizes=((64, 64), (64, 96), (96, 64)), seed=3,
+            max_people=12)
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test")
+        params = cannet_init(jax.random.key(1))
+        # nonzero biases make the forward padding-sensitive — the regime
+        # where a parity bug would actually show (test_bucketed_eval.py)
+        params = jax.tree_util.tree_map(
+            lambda x: x + 0.05 if x.ndim == 1 else x, params)
+        return ds, params
+
+    def test_counts_bit_for_bit(self, setup):
+        ds, params = setup
+        from can_tpu.models import cannet_apply
+        from can_tpu.train import evaluate, make_eval_step
+        from can_tpu.train.loss import density_counts
+
+        # offline: the eval CLI's single-host path (batch 1, exact shapes)
+        ev = jax.jit(make_eval_step(cannet_apply))
+
+        def put(b):
+            return {"image": jnp.asarray(b.image),
+                    "dmap": jnp.asarray(b.dmap),
+                    "pixel_mask": jnp.asarray(b.pixel_mask),
+                    "sample_mask": jnp.asarray(b.sample_mask)}
+
+        batcher = ShardedBatcher(ds, 1, shuffle=False)
+        offline = evaluate(ev, params, batcher.epoch(0), put_fn=put,
+                           dataset_size=batcher.dataset_size)
+
+        # per-image offline counts from the same masked-reduction program
+        @jax.jit
+        def off_counts(params, batch):
+            return density_counts(cannet_apply(params, batch["image"]),
+                                  batch)
+
+        engine = ServeEngine(params)
+        # exact buckets + max_batch 1: the online tensor IS the offline one
+        svc = CountService(engine, max_batch=1, max_wait_ms=1.0)
+        abs_sum = 0.0
+        with svc:
+            for i in range(len(ds)):
+                img, dm = ds[i]
+                h, w = img.shape[:2]
+                served = svc.predict(img, timeout=120.0)
+                batch = put(type("B", (), dict(
+                    image=img[None], dmap=dm[None],
+                    pixel_mask=np.ones((1, h // 8, w // 8, 1), np.float32),
+                    sample_mask=np.ones((1,), np.float32)))())
+                et, gt = off_counts(params, batch)
+                assert served.count == float(et[0])  # BIT-for-bit
+                abs_sum += abs(served.count - float(gt[0]))
+        # and the dataset metric reconstructed from served counts matches
+        # evaluate()'s exactly
+        assert abs_sum / len(ds) == offline["mae"]
+
+
+class TestServeTelemetryReport:
+    def test_serve_events_summarized(self, tmp_path):
+        tel = obs.open_host_telemetry(str(tmp_path), host_id=0)
+        tel.emit("serve.request", latency_s=0.010, bucket=[64, 64], ok=True)
+        tel.emit("serve.request", latency_s=0.030, bucket=[64, 64], ok=True)
+        tel.emit("serve.batch", bucket=[64, 64], size=4, valid=3, fill=0.75,
+                 execute_s=0.008, queue_depth=5)
+        tel.emit("serve.batch", bucket=[96, 96], size=4, valid=1, fill=0.25,
+                 execute_s=0.009, queue_depth=2)
+        tel.emit("serve.reject", reason=REJECT_DEADLINE, count=1)
+        tel.emit("serve.reject", reason=REJECT_BACKPRESSURE, count=2)
+        tel.close()
+        path = os.path.join(str(tmp_path), "telemetry.host0.jsonl")
+        s = obs.summarize(obs.read_events(path))
+        assert s["serve_requests"] == 2
+        assert s["serve_latency_p50_s"] == pytest.approx(0.020)
+        assert s["serve_latency_max_s"] == pytest.approx(0.030)
+        assert s["serve_batches"] == 2
+        assert s["serve_mean_fill"] == pytest.approx(0.5)
+        assert s["serve_rejects"] == 3
+        assert s["serve_rejects_by_reason"] == {REJECT_BACKPRESSURE: 2,
+                                                REJECT_DEADLINE: 1}
+        assert s["serve_queue_depth_max"] == 5
+        table = obs.format_report(s)
+        assert "serve p95" in table and "backpressure=2" in table
+
+    def test_offline_run_summary_has_no_serve_rows(self):
+        s = obs.summarize([{"ts": 1.0, "kind": "step_window", "step": 1,
+                            "host_id": 0,
+                            "payload": {"steps": 1, "samples_s": [0.1]}}])
+        assert s["serve_requests"] == 0
+        assert "serve p95" not in obs.format_report(s)
+
+    def test_service_emits_request_batch_reject(self, tmp_path,
+                                                small_engine):
+        tel = obs.open_host_telemetry(str(tmp_path), host_id=0)
+        # rebind the module-scoped engine's bus just for this service:
+        # service-level events (request/batch/reject) go to `tel`
+        svc = CountService(small_engine, max_batch=2, max_wait_ms=2.0,
+                           bucket_ladder=((64,), (64,)), telemetry=tel)
+        svc.warmup([(64, 64)])
+        with svc:
+            svc.predict(np.zeros((64, 64, 3), np.float32), timeout=60.0)
+            t = svc.submit(np.zeros((64, 64, 3), np.float32),
+                           deadline_ms=0.0)
+            with pytest.raises(RejectedError):
+                t.result(timeout=10.0)
+        tel.close()
+        events = obs.read_events(
+            os.path.join(str(tmp_path), "telemetry.host0.jsonl"))
+        kinds = [e["kind"] for e in events]
+        assert "serve.request" in kinds
+        assert "serve.batch" in kinds
+        assert "serve.reject" in kinds
+        batch_ev = next(e for e in events if e["kind"] == "serve.batch")
+        assert {"bucket", "size", "valid", "fill", "execute_s",
+                "queue_depth"} <= set(batch_ev["payload"])
+
+
+class TestStepTimerRecord:
+    def test_record_feeds_reservoir_like_stop(self):
+        from can_tpu.utils import StepTimer
+
+        t = StepTimer(skip_first=1)
+        t.record(10.0)          # skipped (compile-window convention)
+        t.record(0.2, shape=(64, 64))
+        t.record(0.4, shape=(64, 64))
+        p = t.percentiles()
+        assert p["n"] == 2 and p["max_s"] == 0.4
+        assert t.shape_summary()["(64, 64)"]["n"] == 2
+
+
+class TestServeCLIValidation:
+    """cli/serve.py arg plumbing + the corrected --checkpoint-dir sentinel
+    (ADVICE r5) it shares with cli/test.py."""
+
+    def test_bucket_shapes_parse(self):
+        from can_tpu.cli.serve import parse_bucket_shapes
+
+        assert parse_bucket_shapes("384x512, 512x768") == [(384, 512),
+                                                           (512, 768)]
+        with pytest.raises(Exception):
+            parse_bucket_shapes("100x100")  # not /8
+        with pytest.raises(Exception):
+            parse_bucket_shapes("no")
+
+    def test_checkpoint_dir_sentinel_conflicts(self, tmp_path):
+        """An EXPLICIT --checkpoint-dir ./checkpoints alongside --torch-pth
+        must now conflict (it used to slip through the literal-string
+        check), and the default still resolves when no flag was given."""
+        from can_tpu.cli.serve import main as serve_main
+        from can_tpu.cli.test import parse_args, validate_params_source
+
+        pth = tmp_path / "w.pth"
+        pth.write_bytes(b"x")
+        with pytest.raises(SystemExit):
+            serve_main(["--torch-pth", str(pth),
+                        "--checkpoint-dir", "./checkpoints"])
+        with pytest.raises(SystemExit):
+            validate_params_source(parse_args(
+                ["--torch-pth", str(pth),
+                 "--checkpoint-dir", "./checkpoints"]))
+        args = parse_args([])
+        validate_params_source(args)
+        assert args.checkpoint_dir == "./checkpoints"  # default resolves
+        args = parse_args(["--torch-pth", str(pth)])
+        validate_params_source(args)  # torch-pth alone: fine
+
+
+@pytest.mark.slow
+def test_bench_serve_emits_json_report(tmp_path):
+    """bench_serve.py end to end (CPU-smoke scale): JSON report with
+    latency percentiles, throughput, batch fill, and reject rate."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BENCH_SERVE_REQUESTS="24", BENCH_SERVE_CLIENTS="4",
+               BENCH_SERVE_MAX_BATCH="4", BENCH_SERVE_OUT="test",
+               BENCH_SERVE_SIZES="60x60,64x90")
+    out = subprocess.run([sys.executable,
+                          os.path.join(repo, "bench_serve.py")],
+                         capture_output=True, text=True, cwd=str(tmp_path),
+                         env=env, timeout=600)
+    assert out.returncode == 0, out.stderr
+    report = json.load(open(tmp_path / "BENCH_SERVE_test.json"))
+    for phase in ("closed_loop", "open_loop"):
+        for k in ("p50_ms", "p95_ms", "p99_ms", "throughput_rps",
+                  "reject_rate"):
+            assert k in report[phase]
+    assert report["compiles_bounded"]
+    assert 0 < report["mean_batch_fill"] <= 1.0
+    # zero hangs: every request accounted for
+    assert (report["closed_loop"]["completed"]
+            + report["closed_loop"]["rejected"]) == 24
+    assert (report["open_loop"]["completed"]
+            + report["open_loop"]["rejected"]) == 24

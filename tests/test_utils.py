@@ -210,3 +210,58 @@ class TestStableRunId:
         f = tmp_path / "id.txt"
         f.write_text("")
         assert _stable_run_id(str(f))
+
+
+class TestMultihostMetadataGate:
+    """parallel/runtime.py::_multihost_metadata_present (ADVICE r5): a bare
+    coordinator var inherited from a stale pod session must NOT route a
+    single-worker machine into the fatal split-brain branch."""
+
+    def _present(self, monkeypatch, env):
+        from can_tpu.parallel.runtime import _multihost_metadata_present
+
+        for var in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS",
+                    "TPU_WORKER_HOSTNAMES", "NUM_PROCESSES",
+                    "JAX_NUM_PROCESSES", "TPU_WORKER_COUNT",
+                    "MEGASCALE_NUM_SLICES"):
+            monkeypatch.delenv(var, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        return _multihost_metadata_present()
+
+    def test_clean_env_is_single_host(self, monkeypatch):
+        assert not self._present(monkeypatch, {})
+
+    def test_bare_coordinator_var_is_not_a_pod(self, monkeypatch):
+        assert not self._present(
+            monkeypatch, {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:8476"})
+        assert not self._present(
+            monkeypatch, {"MEGASCALE_COORDINATOR_ADDRESS": "10.0.0.1:8476"})
+
+    def test_coordinator_plus_worker_count_is_a_pod(self, monkeypatch):
+        assert self._present(monkeypatch,
+                             {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+                              "NUM_PROCESSES": "2"})
+        assert self._present(monkeypatch,
+                             {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+                              "JAX_NUM_PROCESSES": "4"})
+        assert self._present(monkeypatch,
+                             {"MEGASCALE_COORDINATOR_ADDRESS": "x:1",
+                              "MEGASCALE_NUM_SLICES": "4"})
+
+    def test_coordinator_with_count_one_degrades(self, monkeypatch):
+        assert not self._present(monkeypatch,
+                                 {"JAX_COORDINATOR_ADDRESS": "x:1",
+                                  "NUM_PROCESSES": "1"})
+
+    def test_multi_hostname_list_is_a_pod_without_coordinator(self,
+                                                              monkeypatch):
+        assert self._present(monkeypatch,
+                             {"TPU_WORKER_HOSTNAMES": "host-a,host-b"})
+        assert not self._present(monkeypatch,
+                                 {"TPU_WORKER_HOSTNAMES": "host-a"})
+
+    def test_garbage_count_var_is_ignored(self, monkeypatch):
+        assert not self._present(monkeypatch,
+                                 {"JAX_COORDINATOR_ADDRESS": "x:1",
+                                  "NUM_PROCESSES": "not-a-number"})
