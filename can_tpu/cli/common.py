@@ -95,6 +95,17 @@ def resolve_split_roots(split: str, image_root: str, gt_root: str,
     return dataset_roots(data_root, split)
 
 
+def split_prepared_spec(spec: str, split: str) -> str:
+    """``--prepared-root`` value -> ``CrowdDataset(prepared=...)`` for one
+    split.  'auto'/'off' pass through; a path is a root holding per-split
+    stores (``<path>/train``, ``<path>/test`` — what
+    ``tools/prepare_data.py --prepared-out`` writes for multi-split runs).
+    """
+    if spec in ("auto", "off"):
+        return spec
+    return os.path.join(spec, split)
+
+
 def build_mesh_and_batch(batch_size: int, sp: int) -> Tuple:
     """Mesh over all devices with ``sp`` spatial shards; returns
     (mesh, per_host_batch, dp).
@@ -157,9 +168,16 @@ def activation_bytes(batch: int, h: int, w: int, *,
 # mechanisms (max_launch_pixels -> None, remat policy -> never), letting
 # the b16 x 1016x1024 varres launch compile at 16.97 GiB and OOM a
 # 15.75 GiB chip.  A device whose kind is unknown still returns None.
+# NOTE these are the SPEC totals, which are strictly larger than what a
+# program can allocate: PJRT reserves a slice for itself before reporting
+# ``bytes_limit`` (the r5 v5e OOM dump showed 15.75 GiB usable of the
+# 16 GiB spec, ~0.984; other clients reserve a bit more), so
+# ``hbm_bytes_for_device_kind`` derates by ``_PJRT_SPEC_DERATE`` rather
+# than handing the planner bytes the runtime will never grant.
 # ORDERED: lite/cost-optimised variants before their generation's bare
 # entry, so "v5lite..." never hits the bare "v5" (v5p) row and "v4i"
 # never gets a full v4's 32 GiB.
+_PJRT_SPEC_DERATE = 0.97  # spec -> typical usable bytes_limit fraction
 _HBM_BY_DEVICE_KIND = (
     ("v5lite", 16 << 30),    # v5e ("TPU v5 lite", "TPU v5litepod-N")
     ("v5e", 16 << 30),
@@ -176,14 +194,17 @@ _HBM_BY_DEVICE_KIND = (
 
 
 def hbm_bytes_for_device_kind(kind: str) -> Optional[int]:
-    """Spec HBM bytes for a TPU ``device_kind`` string, or None if the
-    generation isn't recognised.  Matched case-insensitively with spaces
-    stripped, first entry wins ("TPU v5 lite" and "TPU v5litepod-8" both
-    hit "v5lite"; bare "TPU v5" falls through to the v5p row)."""
+    """USABLE HBM bytes for a TPU ``device_kind`` string (spec total
+    derated by the typical PJRT reservation, ``_PJRT_SPEC_DERATE`` — a
+    real client's ``bytes_limit`` always comes in under spec), or None if
+    the generation isn't recognised.  Matched case-insensitively with
+    spaces stripped, first entry wins ("TPU v5 lite" and "TPU
+    v5litepod-8" both hit "v5lite"; bare "TPU v5" falls through to the
+    v5p row)."""
     k = kind.lower().replace(" ", "")
     for sub, size in _HBM_BY_DEVICE_KIND:
         if sub in k:
-            return size
+            return int(size * _PJRT_SPEC_DERATE)
     return None
 
 

@@ -91,6 +91,21 @@ def parse_args(argv=None):
     p.add_argument("--num-workers", type=int, default=None,
                    help="host data-loading threads (default: min(8, cpus); "
                         "0 = main thread)")
+    p.add_argument("--prepared-root", type=str, default="auto",
+                   help="prepared 1/8-density store: 'auto' (default) "
+                        "probes <gt_root>/prepared and falls back to the "
+                        "legacy decode when absent/stale; 'off' disables; "
+                        "a path points at a root holding per-split stores "
+                        "(<path>/<split>, the train CLI's and "
+                        "--prepared-out's layout) and MUST validate "
+                        "(numerics are bit-identical either way — see "
+                        "tools/prepare_data.py --prepared)")
+    p.add_argument("--item-cache-mb", type=float, default=0.0,
+                   help="bounded in-RAM LRU over decoded items, in MB "
+                        "(0 = off).  A single eval pass decodes each "
+                        "unique item once regardless — this pays off for "
+                        "fill-slot duplicates and for callers that loop "
+                        "evaluations in one process")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables)")
@@ -185,6 +200,8 @@ def main(argv=None) -> int:
         args.split, args.image_root, args.gt_root, args.data_root,
         flag_stem="")
     validate_params_source(args)
+    if args.item_cache_mb < 0:
+        raise SystemExit("--item-cache-mb must be >= 0")
     from can_tpu.cli.train import (
         apply_compile_cache,
         apply_platform,
@@ -204,8 +221,26 @@ def main(argv=None) -> int:
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
-        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
-                          u8_output=args.u8_input)
+        from can_tpu.data import ItemCache, StaleStoreError
+
+        item_cache = (ItemCache(int(args.item_cache_mb * 1e6))
+                      if args.item_cache_mb > 0 else None)
+        from can_tpu.cli.common import split_prepared_spec
+
+        try:
+            ds = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                              phase="test", u8_output=args.u8_input,
+                              prepared=split_prepared_spec(
+                                  args.prepared_root, args.split),
+                              item_cache=item_cache)
+        except StaleStoreError as e:
+            raise SystemExit(f"--prepared-root {args.prepared_root}: {e}")
+        telemetry.emit("data.prepared", split=args.split,
+                       **ds.prepared_note)
+        if process_index() == 0:
+            note = ds.prepared_note
+            print(f"[data] prepared store: "
+                  f"{'on' if note['active'] else 'legacy(' + str(note['reason']) + ')'}")
         # per-host slice of the lockstep schedule, like the train CLI —
         # without this a multi-host pod would feed every image
         # process_count times
@@ -290,6 +325,8 @@ def main(argv=None) -> int:
             batcher.close()
         telemetry.emit("epoch", step=0, phase="eval", mae=metrics["mae"],
                        mse=metrics["mse"], num_images=metrics["num_images"])
+        if item_cache is not None:
+            telemetry.emit("data.cache", step=0, **item_cache.stats())
         print(f"[result] images={metrics['num_images']} "
               f"MAE={metrics['mae']:.3f} MSE={metrics['mse']:.3f}")
 

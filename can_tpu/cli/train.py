@@ -169,6 +169,25 @@ def parse_args(argv=None):
                         "resize + pad; the reference's DataLoader "
                         "num_workers, train.py:90). Default: min(8, cpus); "
                         "0 = load in the main thread")
+    p.add_argument("--prepared-root", type=str, default="auto",
+                   help="prepared 1/8-density store (tools/prepare_data.py "
+                        "--prepared): 'auto' (default) probes each split's "
+                        "<gt_root>/prepared and falls back to the legacy "
+                        "decode path when absent/stale; 'off' disables; a "
+                        "path points at a root holding per-split stores "
+                        "(<path>/train, <path>/test) and MUST validate")
+    p.add_argument("--item-cache-mb", type=float, default=0.0,
+                   help="bounded in-RAM LRU over fully-decoded items, in "
+                        "MB (shared across train+test splits; 0 = off): "
+                        "datasets that fit decode once, then epochs serve "
+                        "from memory — counters land as data.cache "
+                        "telemetry events")
+    p.add_argument("--allow-config-change", action="store_true",
+                   help="permit resuming (--init_checkpoint) with "
+                        "schedule-bearing flags (lr/lrf/epochs/batch/seed/"
+                        "syncBN/bf16) that differ from the ones the "
+                        "checkpoint was trained with — without this flag, "
+                        "drift is an error, not a silent schedule break")
     p.add_argument("--max-buckets", type=int, default=24,
                    help="compile budget for --pad-multiple auto: max "
                         "distinct batch shapes per step. More buckets = "
@@ -296,6 +315,37 @@ def main(argv=None) -> int:
                              "the warm-started params; pick one")
         if not os.path.isfile(args.init_torch_pth):
             raise SystemExit(f"no such checkpoint file: {args.init_torch_pth}")
+    if args.item_cache_mb < 0:
+        raise SystemExit("--item-cache-mb must be >= 0")
+    # resume-config guard (pure file reading, BEFORE any runtime init):
+    # a schedule-bearing flag that silently differs from the checkpoint's
+    # run breaks the cosine schedule / data order the resumed state
+    # assumes — fail here unless the drift is explicitly allowed
+    run_cfg = {"lr": args.lr, "lrf": args.lrf, "epochs": args.epochs,
+               "batch_size": args.batch_size, "seed": args.seed,
+               "syncBN": bool(args.syncBN), "bf16": bool(args.bf16)}
+    from can_tpu.utils.checkpoint import (
+        ConfigDriftError,
+        check_resume_config,
+        has_checkpoint,
+        load_run_config,
+        save_run_config,
+    )
+
+    if args.init_checkpoint:
+        saved_cfg = load_run_config(args.init_checkpoint)
+        # guard only REAL resumes: a config with no checkpoint beside it
+        # (a run that crashed before its first save) cold-starts, and a
+        # cold start has no restored schedule to protect
+        if saved_cfg is not None and has_checkpoint(args.init_checkpoint):
+            try:
+                drifted = check_resume_config(saved_cfg, run_cfg,
+                                              allow=args.allow_config_change)
+            except ConfigDriftError as e:
+                raise SystemExit(f"{e} (pass --allow-config-change to "
+                                 "resume with the new schedule anyway)")
+            if drifted:
+                print(f"[resume] config drift allowed: {', '.join(drifted)}")
     trace_window = validate_trace_args(args)
     apply_platform(args)
     topo = init_runtime()
@@ -315,10 +365,26 @@ def main(argv=None) -> int:
     if args.sp > 1 and main_proc and pad_multiple != "auto":
         print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
 
-    train_ds = CrowdDataset(train_img, train_gt, gt_downsample=8,
-                            phase="train", u8_output=args.u8_input)
-    test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8, phase="test",
-                           u8_output=args.u8_input)
+    from can_tpu.cli.common import split_prepared_spec
+    from can_tpu.data import ItemCache, StaleStoreError
+
+    # one cache across both splits (keys carry the dataset root): the
+    # budget is a single host-RAM promise, not one per split
+    item_cache = (ItemCache(int(args.item_cache_mb * 1e6))
+                  if args.item_cache_mb > 0 else None)
+    try:
+        train_ds = CrowdDataset(train_img, train_gt, gt_downsample=8,
+                                phase="train", u8_output=args.u8_input,
+                                prepared=split_prepared_spec(
+                                    args.prepared_root, "train"),
+                                item_cache=item_cache)
+        test_ds = CrowdDataset(test_img, test_gt, gt_downsample=8,
+                               phase="test", u8_output=args.u8_input,
+                               prepared=split_prepared_spec(
+                                   args.prepared_root, "test"),
+                               item_cache=item_cache)
+    except StaleStoreError as e:
+        raise SystemExit(f"--prepared-root {args.prepared_root}: {e}")
     num_workers = resolve_num_workers(args)
     import math as _math
 
@@ -405,6 +471,10 @@ def main(argv=None) -> int:
     state = create_train_state(params, optimizer, init_batch_stats(params))
 
     ckpt = CheckpointManager(args.checkpoint_dir)
+    if main_proc:
+        # persist the schedule-bearing config beside the checkpoints so
+        # the NEXT resume can detect flag drift (checked above)
+        save_run_config(args.checkpoint_dir, run_cfg)
     start_epoch = 0
     resumed_best = None
     if args.init_checkpoint:
@@ -477,6 +547,15 @@ def main(argv=None) -> int:
     telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
                                            trace_window=trace_window,
                                            logger=logger)
+    # prepared-store status: one data.prepared event per split (the
+    # one-line fallback record the store contract requires), echoed on
+    # stdout for the main process
+    for split, d in (("train", train_ds), ("test", test_ds)):
+        telemetry.emit("data.prepared", split=split, **d.prepared_note)
+    if main_proc:
+        print("[data] prepared store: " + " ".join(
+            f"{split}={'on' if d.prepared_note['active'] else 'legacy(' + str(d.prepared_note['reason']) + ')'}"
+            for split, d in (("train", train_ds), ("test", test_ds))))
     # the LOOPS are instrumented only when something consumes per-step
     # data (JSONL sink or a trace window): the default run's hot path
     # must stay byte-identical — the bus still carries the once-per-epoch
@@ -528,6 +607,10 @@ def main(argv=None) -> int:
                 # is GSPMD-reduced in-program, so every host computes the
                 # same number and host 0's MetricLogger reports it.
                 telemetry.emit("epoch", step=epoch, **epoch_metrics)
+                if item_cache is not None:
+                    # cumulative counters; the report reads the last event
+                    telemetry.emit("data.cache", step=epoch,
+                                   **item_cache.stats())
                 if eval_epoch:
                     ckpt.save(epoch, state, mae=mae,
                               extra={"mse": metrics["mse"]})

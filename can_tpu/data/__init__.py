@@ -3,6 +3,7 @@ from .dataset import CrowdDataset, IMAGENET_MEAN, IMAGENET_STD, normalize_host
 from .batching import ShardedBatcher, Batch, pad_batch, snap_to_bucket
 from .synthetic import make_synthetic_dataset
 from .prefetch import PrefetchPutError, prefetch_to_device
+from .prepared import ItemCache, PreparedStore, StaleStoreError, write_store
 
 __all__ = [
     "gaussian_density_map",
@@ -18,4 +19,8 @@ __all__ = [
     "make_synthetic_dataset",
     "prefetch_to_device",
     "PrefetchPutError",
+    "ItemCache",
+    "PreparedStore",
+    "StaleStoreError",
+    "write_store",
 ]

@@ -40,11 +40,14 @@ import numpy as np
 # informational — emit() accepts any kind string.  serve.* kinds come from
 # the online serving subsystem (can_tpu/serve): per-request completions,
 # per-flush micro-batches (carrying the queue-depth gauge), and typed
-# rejections.
+# rejections.  data.* kinds come from the host data pipeline
+# (can_tpu/data/prepared.py): per-split prepared-store status (active or
+# the fallback reason) and per-epoch decoded-item-cache counters.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
-               "serve.warmup")
+               "serve.warmup",
+               "data.prepared", "data.cache")
 
 
 def _jsonable(v):

@@ -59,6 +59,8 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_slots = 0
     serve_valid = 0
     serve_queue_depth_max = None
+    cache_last: Optional[dict] = None
+    prepared_splits: dict = {}
     for e in events:
         kind = e.get("kind", "?")
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -110,6 +112,12 @@ def summarize(events: Iterable[dict]) -> dict:
             reason = str(p.get("reason", "?"))
             serve_rejects[reason] = (serve_rejects.get(reason, 0)
                                      + int(p.get("count", 1)))
+        elif kind == "data.cache":
+            cache_last = p  # counters are cumulative: the last wins
+        elif kind == "data.prepared":
+            split = str(p.get("split", "?"))
+            prepared_splits[split] = ("on" if p.get("active")
+                                      else f"legacy({p.get('reason', '?')})")
     wall_s = (last_ts - first_ts) if first_ts is not None else None
     return {
         "events": len(events),
@@ -140,6 +148,16 @@ def summarize(events: Iterable[dict]) -> dict:
         "serve_rejects": sum(serve_rejects.values()),
         "serve_rejects_by_reason": dict(sorted(serve_rejects.items())),
         "serve_queue_depth_max": serve_queue_depth_max,
+        # host data pipeline (can_tpu/data/prepared.py); Nones/empty offline
+        "prepared_splits": dict(sorted(prepared_splits.items())),
+        "cache_hits": cache_last.get("hits") if cache_last else None,
+        "cache_misses": cache_last.get("misses") if cache_last else None,
+        "cache_hit_rate": cache_last.get("hit_rate") if cache_last else None,
+        "cache_bytes": cache_last.get("bytes") if cache_last else None,
+        "cache_capacity_bytes": (cache_last.get("capacity_bytes")
+                                 if cache_last else None),
+        "cache_evictions": (cache_last.get("evictions")
+                            if cache_last else None),
     }
 
 
@@ -174,6 +192,20 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
         ("peak host RSS", _fmt(summary["peak_host_rss_mb"], " MB")),
         ("heartbeats", _fmt(summary["heartbeats"])),
     ]
+    if summary.get("prepared_splits"):
+        rows.append(("prepared store",
+                     " ".join(f"{k}={v}" for k, v in
+                              summary["prepared_splits"].items())))
+    if summary.get("cache_hits") is not None:
+        cap = summary.get("cache_capacity_bytes")
+        rows += [
+            ("item cache", f"hits={summary['cache_hits']} "
+                           f"misses={summary['cache_misses']} "
+                           f"hit_rate={_fmt(summary['cache_hit_rate'])}"),
+            ("item cache bytes",
+             f"{_fmt(summary['cache_bytes'])} / {_fmt(cap)}"
+             f" (evictions={_fmt(summary['cache_evictions'])})"),
+        ]
     if summary.get("serve_requests") or summary.get("serve_rejects"):
         rejects = summary.get("serve_rejects_by_reason") or {}
         rows += [

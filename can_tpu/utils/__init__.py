@@ -1,4 +1,10 @@
-from .checkpoint import CheckpointManager
+from .checkpoint import (
+    CheckpointManager,
+    ConfigDriftError,
+    check_resume_config,
+    load_run_config,
+    save_run_config,
+)
 from .compile_cache import default_cache_dir, enable_compilation_cache
 from .logging import MetricLogger
 from .viz import save_density_visualization
@@ -12,6 +18,10 @@ from .profiling import (
 
 __all__ = [
     "CheckpointManager",
+    "ConfigDriftError",
+    "check_resume_config",
+    "load_run_config",
+    "save_run_config",
     "MetricLogger",
     "save_density_visualization",
     "StepTimer",

@@ -11,10 +11,74 @@ replicated params this reduces to primary-only writes).
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+from typing import List, Optional
 
 from can_tpu.train.state import TrainState
+
+RUN_CONFIG_NAME = "run_config.json"
+
+
+class ConfigDriftError(ValueError):
+    """A schedule-bearing flag differs from the checkpoint's run config."""
+
+
+def save_run_config(directory: str, config: dict) -> str:
+    """Persist the schedule-bearing run config (lr, lrf, epochs, batch,
+    seed, syncBN, bf16) beside the checkpoints, atomically.  The reference
+    resumes with ``strict=False`` and whatever flags the new invocation
+    happens to carry (train.py:98-102) — a changed ``--epochs`` silently
+    reshapes the cosine schedule the restored optimizer state was built
+    for.  Rank 0 writes; every rank reads (the check is pure file IO)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, RUN_CONFIG_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(config, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def has_checkpoint(directory: str) -> bool:
+    """Cheap "is there anything to resume" probe: integer-named step
+    subdirectories (the Orbax on-disk layout).  Scopes the drift guard to
+    REAL resumes — a run that wrote its config then crashed before the
+    first save leaves nothing whose schedule needs protecting, and
+    rejecting its cold restart would demand --allow-config-change for a
+    no-op."""
+    try:
+        return any(e.isdigit() and os.path.isdir(os.path.join(directory, e))
+                   for e in os.listdir(directory))
+    except OSError:
+        return False
+
+
+def load_run_config(directory: str) -> Optional[dict]:
+    """The saved run config, or None when the directory predates the
+    guard (older checkpoints resume unchecked rather than erroring)."""
+    path = os.path.join(directory, RUN_CONFIG_NAME)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_resume_config(saved: dict, current: dict, *,
+                        allow: bool = False) -> List[str]:
+    """Compare a checkpoint's saved run config against the resuming run's.
+
+    Returns the sorted list of drifted keys; raises
+    :class:`ConfigDriftError` naming each ``key: saved -> current`` unless
+    ``allow`` (the CLI's ``--allow-config-change``)."""
+    keys = sorted(set(saved) | set(current))
+    drifted = [k for k in keys if saved.get(k) != current.get(k)]
+    if drifted and not allow:
+        detail = ", ".join(f"{k}: {saved.get(k)!r} -> {current.get(k)!r}"
+                           for k in drifted)
+        raise ConfigDriftError(
+            f"resume config drift vs the checkpoint's run ({detail})")
+    return drifted
 
 
 class CheckpointManager:

@@ -16,6 +16,12 @@ Modes:
            the r4 planner's lockstep contract — every host derives the
            same (shape x size) schedule incl. sub-full launches — proven
            across real OS-process boundaries
+  ckpt1    dp config: train epoch 0, then SAVE a full-state checkpoint
+           through the multihost Orbax path (every rank participates)
+  ckpt2    fresh processes RESTORE that checkpoint and train epoch 1 —
+           the restart leg of the train->save->restart->restore->continue
+           cycle (VERDICT weak #5); its loss must match an uninterrupted
+           2-epoch run's epoch-1 loss
 
 Usage: python tests/multiproc_worker.py <rank> <nprocs> <port> <out_dir> [mode]
 """
@@ -112,8 +118,30 @@ def main():
         eval_step = make_dp_eval_step(cannet_apply, mesh)
         put = lambda b: make_global_batch(b, mesh)
         eval_bs = 4
-    state, train_stats = train_one_epoch(step, state, batcher.epoch(0),
+    epoch_idx = 0
+    ckpt = None
+    if mode in ("ckpt1", "ckpt2"):
+        from can_tpu.utils import CheckpointManager
+
+        ckpt = CheckpointManager(os.path.join(out_dir, "ck"))
+        if mode == "ckpt2":
+            # the restart leg: restore the FULL state (params + optimizer
+            # momentum + step) every rank, continue on epoch 1 — the
+            # lockstep schedule is keyed on (seed, epoch), so the resumed
+            # epoch is byte-identical to the uninterrupted run's
+            latest = ckpt.latest_epoch()
+            assert latest == 0, f"expected the ckpt1 save, got {latest}"
+            state = ckpt.restore(state)
+            epoch_idx = 1
+    state, train_stats = train_one_epoch(step, state, batcher.epoch(epoch_idx),
                                        put_fn=put, show_progress=False)
+    if mode == "ckpt1":
+        # multihost save: every rank calls save (Orbax coordinates; with
+        # replicated params this reduces to primary-only writes)
+        ckpt.save(0, state, mae=1.0)
+        ckpt.wait()
+    if ckpt is not None:
+        ckpt.close()
 
     # evaluate() across REAL process boundaries: the lockstep eval schedule,
     # the n_seen == dataset_size guard, and the replicated metric fetch must

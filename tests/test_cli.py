@@ -87,6 +87,80 @@ class TestCheckpointManager:
             main(["--data_root", "/nonexistent", "--eval-interval", "0"])
 
 
+class TestResumeConfigGuard:
+    """VERDICT weak #4: resuming with drifted schedule-bearing flags used
+    to silently reshape the cosine schedule the restored optimizer state
+    was built for.  The run config is persisted beside the checkpoints and
+    checked BEFORE any runtime work on warm-start."""
+
+    def test_round_trip_and_drift_check(self, tmp_path):
+        from can_tpu.utils import (
+            ConfigDriftError,
+            check_resume_config,
+            load_run_config,
+            save_run_config,
+        )
+
+        cfg = {"lr": 1e-7, "lrf": 1.0, "epochs": 500, "batch_size": 4,
+               "seed": 0, "syncBN": False, "bf16": True}
+        save_run_config(str(tmp_path), cfg)
+        assert load_run_config(str(tmp_path)) == cfg
+        # identical config: no drift, continues
+        assert check_resume_config(cfg, dict(cfg)) == []
+        # a changed --epochs is rejected, naming the key and both values
+        changed = dict(cfg, epochs=600)
+        with pytest.raises(ConfigDriftError, match="epochs: 500 -> 600"):
+            check_resume_config(cfg, changed)
+        # ... unless explicitly allowed, in which case the drifted keys
+        # come back for the CLI to announce
+        assert check_resume_config(cfg, changed, allow=True) == ["epochs"]
+        # pre-guard checkpoint dirs resume unchecked (None, not an error)
+        assert load_run_config(str(tmp_path / "nope")) is None
+
+    def test_cli_rejects_changed_epochs_and_continues_identical(
+            self, data_root, tmp_path):
+        from can_tpu.cli.train import main as train_main
+        from can_tpu.utils import load_run_config
+        from can_tpu.utils.checkpoint import has_checkpoint
+
+        ckdir = str(tmp_path / "ck_guard")
+        base = ["--data_root", data_root, "--batch-size", "1",
+                "--lr", "1e-7", "--seed", "0",
+                "--checkpoint-dir", ckdir,
+                "--max-steps-per-epoch", "1"]
+        # leg 1: a real run leaves a checkpoint AND its run config
+        assert train_main(base + ["--epochs", "1"]) == 0
+        assert has_checkpoint(ckdir)
+        resume = base + ["--init_checkpoint", ckdir]
+        # changed --epochs vs the checkpoint's run: rejected
+        with pytest.raises(SystemExit, match="epochs"):
+            train_main(resume + ["--epochs", "3"])
+        # identical config: the resume proceeds
+        assert train_main(resume + ["--epochs", "1"]) == 0
+        assert load_run_config(ckdir)["epochs"] == 1
+
+    def test_guard_skips_configs_with_no_checkpoint(self, tmp_path,
+                                                    data_root):
+        # a run that wrote its config then crashed before the first save
+        # has no restored schedule to protect: its cold restart must NOT
+        # demand --allow-config-change
+        from can_tpu.cli.train import main as train_main
+        from can_tpu.utils import save_run_config
+        from can_tpu.utils.checkpoint import has_checkpoint
+
+        ckdir = str(tmp_path / "ck_crashed")
+        save_run_config(ckdir, {"lr": 1e-7, "lrf": 1.0, "epochs": 2,
+                                "batch_size": 1, "seed": 0,
+                                "syncBN": False, "bf16": False})
+        assert not has_checkpoint(ckdir)
+        assert train_main(["--data_root", data_root, "--batch-size", "1",
+                           "--lr", "1e-7", "--seed", "0",
+                           "--checkpoint-dir", ckdir,
+                           "--init_checkpoint", ckdir,
+                           "--max-steps-per-epoch", "1",
+                           "--epochs", "1"]) == 0
+
+
 class TestTrainCLI:
     def test_train_eval_resume(self, data_root, tmp_path):
         from can_tpu.cli.train import main as train_main
@@ -102,11 +176,15 @@ class TestTrainCLI:
         assert ck.latest_epoch() == 1
         ck.close()
 
-        # resume for one more epoch from the saved state
+        # resume for one more epoch from the saved state; the longer
+        # --epochs is schedule drift vs the checkpoint's run config, so
+        # it must be explicitly allowed (the guard's rejection path is
+        # pinned in TestResumeConfigGuard)
         argv_resume = ["--data_root", data_root, "--epochs", "3",
                        "--batch-size", "1", "--lr", "1e-7",
                        "--checkpoint-dir", ckdir,
-                       "--init_checkpoint", ckdir, "--seed", "0"]
+                       "--init_checkpoint", ckdir, "--seed", "0",
+                       "--allow-config-change"]
         assert train_main(argv_resume) == 0
         ck = CheckpointManager(ckdir)
         assert ck.latest_epoch() == 2
@@ -364,21 +442,36 @@ class TestRematPolicy:
         # AND auto-remat -> the b16 x 1016x1024 launch compiled at
         # 16.97 GiB and OOM'd the chip.  The spec table keeps the
         # fits-in-HBM machinery alive on such clients.
-        from can_tpu.cli.common import hbm_bytes_for_device_kind, max_launch_pixels
+        from can_tpu.cli.common import (
+            _PJRT_SPEC_DERATE,
+            hbm_bytes_for_device_kind,
+            max_launch_pixels,
+        )
 
-        assert hbm_bytes_for_device_kind("TPU v5 lite") == 16 << 30
-        assert hbm_bytes_for_device_kind("TPU v5litepod-16") == 16 << 30
-        assert hbm_bytes_for_device_kind("TPU v5e") == 16 << 30
-        assert hbm_bytes_for_device_kind("TPU v5p") == 95 << 30
+        # ADVICE r5: spec values are derated by the typical PJRT
+        # reservation (the r5 v5e OOM dump showed 15.75 GiB usable of the
+        # 16 GiB spec) — spec > bytes_limit always, so handing the planner
+        # raw spec bytes overpromises
+
+        def spec(gib):
+            return int((gib << 30) * _PJRT_SPEC_DERATE)
+
+        assert hbm_bytes_for_device_kind("TPU v5 lite") == spec(16)
+        assert hbm_bytes_for_device_kind("TPU v5litepod-16") == spec(16)
+        assert hbm_bytes_for_device_kind("TPU v5e") == spec(16)
+        assert hbm_bytes_for_device_kind("TPU v5p") == spec(95)
         # real v5p clients report bare "TPU v5" (v5e always says lite/e)
-        assert hbm_bytes_for_device_kind("TPU v5") == 95 << 30
-        assert hbm_bytes_for_device_kind("TPU v4") == 32 << 30
+        assert hbm_bytes_for_device_kind("TPU v5") == spec(95)
+        assert hbm_bytes_for_device_kind("TPU v4") == spec(32)
         # lite/inference variants must NOT inherit the full part's HBM
-        assert hbm_bytes_for_device_kind("TPU v4i") == 8 << 30
-        assert hbm_bytes_for_device_kind("TPU v4 lite") == 8 << 30
-        assert hbm_bytes_for_device_kind("TPU v3") == 16 << 30
+        assert hbm_bytes_for_device_kind("TPU v4i") == spec(8)
+        assert hbm_bytes_for_device_kind("TPU v4 lite") == spec(8)
+        assert hbm_bytes_for_device_kind("TPU v3") == spec(16)
         assert hbm_bytes_for_device_kind("cpu") is None
         assert hbm_bytes_for_device_kind("Fancy NPU 9000") is None
+        # the derate stays under every real bytes_limit seen (15.75/16 =
+        # 0.984 on v5e) without rejecting configurations that fit
+        assert 0.9 < _PJRT_SPEC_DERATE < 0.984
         # the spec-derived cap must reject the measured OOM launch and
         # admit the known fits, same as the bytes_limit-derived one
         cap = max_launch_pixels(
@@ -407,7 +500,8 @@ class TestRematPolicy:
 
         monkeypatch.setattr(common.jax, "local_devices",
                             lambda: [FakeDev("TPU v5 lite")])
-        assert common.device_memory_bytes() == 16 << 30
+        assert common.device_memory_bytes() == int(
+            (16 << 30) * common._PJRT_SPEC_DERATE)
         # a reported bytes_limit always wins over the spec table
         monkeypatch.setattr(
             common.jax, "local_devices",

@@ -146,6 +146,109 @@ class TestCrowdDataset:
         assert any(flips) and not all(flips)
 
 
+class TestPreparedParity:
+    """Acceptance (this PR): the prepared-store fast path must be
+    BIT-EXACT against the legacy decode+resize path on the f32 route,
+    including the flip case.  Flip does not commute with cv2's bilinear
+    resize in f32 (~4e-6, every tested snapped width) — which is exactly
+    why the store bakes BOTH orientations offline instead of flipping the
+    small map online; the non-commutation itself is pinned below so a
+    future 'simplification' to online small-map flipping fails loudly."""
+
+    @pytest.fixture()
+    def prepared_synth(self, tmp_path):
+        from can_tpu.data import make_synthetic_dataset, write_store
+
+        # widths NOT multiples of 8: the snapped resize grid where the
+        # flip/resize order matters most
+        img_root, gt_root = make_synthetic_dataset(
+            str(tmp_path / "prep"), 6,
+            sizes=((100, 140), (97, 135), (120, 150)), seed=5)
+        write_store(img_root, gt_root)
+        return img_root, gt_root
+
+    def _pair(self, prepared_synth, **kw):
+        img_root, gt_root = prepared_synth
+        legacy = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                              prepared="off", **kw)
+        fast = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                            prepared="auto", **kw)
+        assert fast.prepared is not None, fast.prepared_note
+        return legacy, fast
+
+    def test_bit_exact_no_flip(self, prepared_synth):
+        legacy, fast = self._pair(prepared_synth, phase="test")
+        for i in range(len(legacy)):
+            a_img, a_dm = legacy[i]
+            b_img, b_dm = fast[i]
+            np.testing.assert_array_equal(a_img, b_img)
+            np.testing.assert_array_equal(a_dm, b_dm)
+
+    def test_bit_exact_including_flips(self, prepared_synth):
+        legacy, fast = self._pair(prepared_synth, phase="train")
+        flipped = 0
+        for i in range(len(legacy)):
+            for seed in range(4):
+                r1 = np.random.default_rng((seed, 0, i))
+                r2 = np.random.default_rng((seed, 0, i))
+                a_img, a_dm = legacy.__getitem__(i, rng=r1)
+                b_img, b_dm = fast.__getitem__(i, rng=r2)
+                np.testing.assert_array_equal(a_img, b_img)
+                np.testing.assert_array_equal(a_dm, b_dm)
+                if not np.array_equal(
+                        a_dm, legacy.__getitem__(i, rng=None)[1]):
+                    flipped += 1
+        assert flipped > 0, "no flip was exercised — the parity is vacuous"
+
+    def test_flip_does_not_commute_with_resize(self, prepared_synth):
+        # the caveat the dual-orientation bake exists for: flipping the
+        # PREPARED small map is NOT the legacy flip-then-resize result
+        import os
+
+        from can_tpu.data import PreparedStore
+
+        img_root, gt_root = prepared_synth
+        store = PreparedStore.open(PreparedStore.default_root(gt_root),
+                                   gt_dmap_root=gt_root, gt_downsample=8)
+        names = sorted(os.listdir(img_root))
+        differs = [
+            not np.array_equal(store.load(n)[:, ::-1],
+                               store.load(n, flip=True))
+            for n in names
+        ]
+        assert any(differs), ("flip commuted bit-exactly on every item; "
+                              "the dual bake would be redundant")
+
+    def test_u8_mode_parity(self, prepared_synth):
+        legacy, fast = self._pair(prepared_synth, phase="train",
+                                  u8_output=True)
+        for i in range(len(legacy)):
+            r1 = np.random.default_rng((1, 0, i))
+            r2 = np.random.default_rng((1, 0, i))
+            a_img, a_dm = legacy.__getitem__(i, rng=r1)
+            b_img, b_dm = fast.__getitem__(i, rng=r2)
+            assert a_img.dtype == np.uint8 and b_img.dtype == np.uint8
+            np.testing.assert_array_equal(a_img, b_img)
+            np.testing.assert_array_equal(a_dm, b_dm)
+
+    def test_batcher_end_to_end_identical(self, prepared_synth):
+        # through ShardedBatcher with loader threads: padded batches,
+        # masks, everything — the training loop sees identical bytes
+        legacy, fast = self._pair(prepared_synth, phase="train")
+        b0 = ShardedBatcher(legacy, 2, shuffle=True, seed=7,
+                            pad_multiple=64, num_workers=0)
+        b1 = ShardedBatcher(fast, 2, shuffle=True, seed=7,
+                            pad_multiple=64, num_workers=3)
+        try:
+            for s, p in zip(b0.epoch(2), b1.epoch(2)):
+                np.testing.assert_array_equal(s.image, p.image)
+                np.testing.assert_array_equal(s.dmap, p.dmap)
+                np.testing.assert_array_equal(s.pixel_mask, p.pixel_mask)
+                np.testing.assert_array_equal(s.sample_mask, p.sample_mask)
+        finally:
+            b1.close()
+
+
 class TestShardedBatcher:
     def test_exact_mode_masks_all_ones(self, synth):
         ds = CrowdDataset(synth[0], synth[1], gt_downsample=8, phase="test")

@@ -323,6 +323,36 @@ class TestReportRoundTrip:
         s = obs.summarize(obs.read_events(path))
         assert s["steps"] == 5  # still summarizes
 
+    def test_data_pipeline_kinds_summarized(self, tmp_path):
+        """data.prepared (per-split store status) and data.cache
+        (cumulative decoded-item counters; the LAST event wins) land in
+        the summary and the table — the host-pipeline subsystem's
+        telemetry contract."""
+        tel = obs.open_host_telemetry(str(tmp_path), host_id=0)
+        tel.emit("data.prepared", split="train", mode="auto", active=True,
+                 root="/d/prepared", reason=None)
+        tel.emit("data.prepared", split="test", mode="auto", active=False,
+                 root="/d2/prepared", reason="no prepared store")
+        for epoch, (hits, misses) in enumerate([(0, 10), (8, 12)]):
+            tel.emit("data.cache", step=epoch, hits=hits, misses=misses,
+                     hit_rate=hits / max(hits + misses, 1), inserts=misses,
+                     evictions=0, oversize_skips=0, items=misses,
+                     bytes=123456, capacity_bytes=10**9)
+        tel.close()
+        s = obs.summarize(obs.read_events(
+            os.path.join(str(tmp_path), "telemetry.host0.jsonl")))
+        assert s["prepared_splits"] == {
+            "train": "on", "test": "legacy(no prepared store)"}
+        assert s["cache_hits"] == 8 and s["cache_misses"] == 12
+        assert s["cache_hit_rate"] == 0.4
+        assert s["cache_bytes"] == 123456
+        table = obs.format_report(s)
+        assert "prepared store" in table and "item cache" in table
+        # offline runs: no data.* rows, no Nones rendered
+        s0 = obs.summarize([])
+        assert s0["cache_hits"] is None and s0["prepared_splits"] == {}
+        assert "item cache" not in obs.format_report(s0)
+
 
 class TestEvaluateTelemetry:
     def test_eval_loop_emits_windows_and_stall(self, tmp_path):

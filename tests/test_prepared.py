@@ -1,0 +1,362 @@
+"""Prepared-dataset store + decoded-item cache (can_tpu/data/prepared.py).
+
+Bit-exactness of the fast path vs the legacy decode (including the flip
+case) is pinned in tests/test_data.py::TestPreparedParity — the acceptance
+oracle.  This file covers the subsystem's own contracts: store layout and
+manifest, every staleness axis (version, gt_downsample, item coverage,
+snapped-shape drift, file truncation, source rewrite, corruption), the
+explicit-vs-auto failure modes, and the ItemCache's bounds/LRU/counters.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from can_tpu.data import (
+    CrowdDataset,
+    ItemCache,
+    PreparedStore,
+    ShardedBatcher,
+    StaleStoreError,
+    make_synthetic_dataset,
+    write_store,
+)
+from can_tpu.data.prepared import (
+    MANIFEST_NAME,
+    STORE_VERSION,
+    prepared_paths,
+)
+
+
+@pytest.fixture()
+def synth(tmp_path):
+    # non-multiple-of-8 sizes on purpose: the snapped widths where
+    # flip-then-resize != resize-then-flip (the reason both orientations
+    # are baked)
+    img_root, gt_root = make_synthetic_dataset(
+        str(tmp_path / "d"), 6, sizes=((100, 140), (97, 135), (128, 96)),
+        seed=0)
+    store_root = write_store(img_root, gt_root)
+    return img_root, gt_root, store_root
+
+
+def _rewrite_manifest(store_root, mutate):
+    mpath = os.path.join(store_root, MANIFEST_NAME)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    mutate(manifest)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+
+
+class TestStoreLayout:
+    def test_manifest_and_both_orientations(self, synth):
+        img_root, gt_root, store_root = synth
+        assert store_root == os.path.join(gt_root, "prepared")
+        with open(os.path.join(store_root, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+        assert manifest["version"] == STORE_VERSION
+        assert manifest["gt_downsample"] == 8
+        names = sorted(f for f in os.listdir(img_root))
+        assert sorted(manifest["items"]) == names
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
+                          prepared="off")
+        for i, name in enumerate(ds.img_names):
+            entry = manifest["items"][name]
+            assert tuple(entry["hw"]) == ds.snapped_shape(i)
+            plain, flip = prepared_paths(store_root, name)
+            for p in (plain, flip):
+                arr = np.load(p)
+                assert arr.dtype == np.float32
+                h, w = entry["hw"]
+                assert arr.shape == (h // 8, w // 8)
+
+    def test_prepared_maps_are_small(self, synth):
+        # the point of the subsystem: ~1/64 of the full-res bytes
+        img_root, gt_root, store_root = synth
+        name = sorted(os.listdir(img_root))[0]
+        src = os.path.join(gt_root, os.path.splitext(name)[0] + ".npy")
+        plain, _ = prepared_paths(store_root, name)
+        assert os.path.getsize(plain) < os.path.getsize(src) / 16
+
+    def test_open_validates_and_loads(self, synth):
+        img_root, gt_root, store_root = synth
+        names = sorted(os.listdir(img_root))
+        store = PreparedStore.open(store_root, gt_dmap_root=gt_root,
+                                   gt_downsample=8, img_names=names)
+        d = store.load(names[0])
+        df = store.load(names[0], flip=True)
+        assert d.shape == df.shape and not np.array_equal(d, df)
+        assert store.verify(names) == 2 * len(names)
+
+    def test_verbose_bake_and_ds_guard(self, tmp_path, capsys):
+        img_root, gt_root = make_synthetic_dataset(
+            str(tmp_path / "v"), 1, sizes=((64, 64),), seed=1)
+        write_store(img_root, gt_root, verbose=True)
+        assert "->" in capsys.readouterr().out
+        with pytest.raises(ValueError, match="gt_downsample"):
+            write_store(img_root, gt_root, gt_downsample=1)
+
+
+class TestStaleness:
+    """Every mismatch axis must be DETECTED — auto-probe falls back with
+    the reason recorded, an explicit store path raises."""
+
+    def _auto(self, img_root, gt_root):
+        return CrowdDataset(img_root, gt_root, gt_downsample=8,
+                            phase="test", prepared="auto")
+
+    def test_absent_store_falls_back_quietly(self, tmp_path):
+        img_root, gt_root = make_synthetic_dataset(
+            str(tmp_path / "a"), 2, sizes=((64, 64),), seed=2)
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "no prepared store" in ds.prepared_note["reason"]
+
+    def test_version_mismatch(self, synth):
+        img_root, gt_root, store_root = synth
+        _rewrite_manifest(store_root,
+                          lambda m: m.update(version=STORE_VERSION + 1))
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None and "version" in ds.prepared_note["reason"]
+        with pytest.raises(StaleStoreError, match="version"):
+            CrowdDataset(img_root, gt_root, gt_downsample=8,
+                         prepared=store_root)
+
+    def test_gt_downsample_mismatch(self, synth):
+        img_root, gt_root, store_root = synth
+        _rewrite_manifest(store_root, lambda m: m.update(gt_downsample=4))
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "gt_downsample" in ds.prepared_note["reason"]
+
+    def test_item_added_after_bake(self, synth):
+        img_root, gt_root, _ = synth
+        from PIL import Image
+
+        rng = np.random.default_rng(9)
+        Image.fromarray((rng.uniform(0, 1, (64, 64, 3)) * 255)
+                        .astype(np.uint8)).save(
+            os.path.join(img_root, "IMG_9999.jpg"))
+        np.save(os.path.join(gt_root, "IMG_9999.npy"),
+                rng.random((64, 64), np.float32))
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "IMG_9999" in ds.prepared_note["reason"]
+
+    def test_prepared_file_missing_or_truncated(self, synth):
+        img_root, gt_root, store_root = synth
+        name = sorted(os.listdir(img_root))[0]
+        plain, flip = prepared_paths(store_root, name)
+        os.remove(flip)
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None and "missing" in ds.prepared_note["reason"]
+        # restore, then truncate the other orientation
+        np.save(flip, np.load(plain))
+        with open(plain, "ab") as f:
+            f.write(b"x")
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "truncated" in ds.prepared_note["reason"]
+
+    def test_source_rewritten_after_bake(self, synth):
+        img_root, gt_root, _ = synth
+        src = os.path.join(
+            gt_root,
+            os.path.splitext(sorted(os.listdir(img_root))[0])[0] + ".npy")
+        d = np.load(src)
+        time.sleep(0.01)  # ensure a distinct mtime_ns
+        np.save(src, d)
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None and "changed" in ds.prepared_note["reason"]
+
+    def test_snapped_shape_drift(self, synth):
+        img_root, gt_root, store_root = synth
+        name = sorted(os.listdir(img_root))[0]
+
+        def mutate(m):
+            m["items"][name]["hw"] = [8, 8]
+
+        _rewrite_manifest(store_root, mutate)
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "snapped shape" in ds.prepared_note["reason"]
+
+    def test_corruption_caught_by_verify(self, synth):
+        # same-size bit corruption passes the stat checks (open() stays
+        # cheap) but must fail the CRC sweep
+        img_root, gt_root, store_root = synth
+        names = sorted(os.listdir(img_root))
+        plain, _ = prepared_paths(store_root, names[0])
+        data = bytearray(open(plain, "rb").read())
+        data[-1] ^= 0xFF
+        with open(plain, "wb") as f:
+            f.write(data)
+        store = PreparedStore.open(store_root, gt_dmap_root=gt_root,
+                                   gt_downsample=8, img_names=names)
+        with pytest.raises(StaleStoreError, match="checksum"):
+            store.verify()
+
+    def test_interrupted_bake_leaves_no_manifest(self, synth):
+        # the manifest is written LAST: killing a bake mid-way must leave
+        # a store the loader refuses, not a half-readable one
+        img_root, gt_root, store_root = synth
+        os.remove(os.path.join(store_root, MANIFEST_NAME))
+        ds = self._auto(img_root, gt_root)
+        assert ds.prepared is None
+        assert "no prepared store" in ds.prepared_note["reason"]
+
+    def test_off_and_ds1_modes(self, synth):
+        img_root, gt_root, _ = synth
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="test",
+                          prepared="off")
+        assert ds.prepared is None
+        assert ds.prepared_note["reason"] == "disabled"
+        ds1 = CrowdDataset(img_root, gt_root, gt_downsample=1, phase="test",
+                           prepared="auto")
+        assert ds1.prepared is None
+        assert "gt_downsample" in ds1.prepared_note["reason"]
+
+
+class TestItemCache:
+    def _item(self, nbytes):
+        return (np.zeros(nbytes // 2, np.uint8), np.zeros(nbytes // 2, np.uint8))
+
+    def test_hit_miss_counters_and_bytes(self):
+        c = ItemCache(1000)
+        assert c.get("a") is None
+        c.put("a", self._item(100))
+        assert c.get("a") is not None
+        s = c.stats()
+        assert s["hits"] == 1 and s["misses"] == 1 and s["hit_rate"] == 0.5
+        assert s["bytes"] == 100 and s["items"] == 1
+
+    def test_lru_eviction_order(self):
+        c = ItemCache(300)
+        for k in "abc":
+            c.put(k, self._item(100))
+        assert c.get("a") is not None  # refresh a -> b is now LRU
+        c.put("d", self._item(100))
+        assert c.get("b") is None and c.get("a") is not None
+        assert c.get("c") is not None and c.get("d") is not None
+        assert c.stats()["evictions"] == 1
+        assert c.stats()["bytes"] <= 300
+
+    def test_oversize_item_skipped_not_thrashed(self):
+        c = ItemCache(100)
+        c.put("small", self._item(50))
+        c.put("big", self._item(500))
+        assert c.stats()["oversize_skips"] == 1
+        assert c.get("small") is not None  # the big item evicted nothing
+
+    def test_duplicate_put_ignored(self):
+        c = ItemCache(1000)
+        c.put("a", self._item(100))
+        assert not c.put("a", self._item(100))
+        assert c.stats()["inserts"] == 1 and c.stats()["bytes"] == 100
+
+    def test_dataset_cache_parity_and_readonly(self, synth):
+        img_root, gt_root, _ = synth
+        plain = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                             phase="train", prepared="off")
+        cache = ItemCache(1 << 30)
+        cached = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                              phase="train", prepared="off",
+                              item_cache=cache)
+        for epoch in range(3):
+            for i in range(len(plain)):
+                r1 = np.random.default_rng((0, epoch, i))
+                r2 = np.random.default_rng((0, epoch, i))
+                a = plain.__getitem__(i, rng=r1)
+                b = cached.__getitem__(i, rng=r2)
+                np.testing.assert_array_equal(a[0], b[0])
+                np.testing.assert_array_equal(a[1], b[1])
+        s = cache.stats()
+        assert s["hits"] > 0 and s["misses"] > 0
+        assert s["misses"] == s["inserts"]  # every miss was cacheable
+        img, dmap = cached.__getitem__(0, rng=None)
+        assert not img.flags.writeable and not dmap.flags.writeable
+
+    def test_cache_keys_flip_aware(self, synth):
+        # a flipped and an unflipped request for the same index must not
+        # alias — flip does not commute with the resize, so serving one
+        # for the other would silently corrupt augmentation
+        img_root, gt_root, _ = synth
+        cache = ItemCache(1 << 30)
+        ds = CrowdDataset(img_root, gt_root, gt_downsample=8, phase="train",
+                          prepared="off", item_cache=cache)
+        plain = ds.__getitem__(0, rng=None)[1]
+        # find a seed whose rng flips item 0
+        for seed in range(20):
+            rng = np.random.default_rng((seed, 0, 0))
+            flipped = ds.__getitem__(0, rng=rng)[1]
+            if not np.array_equal(flipped, plain):
+                break
+        else:
+            pytest.fail("no flip occurred in 20 seeds")
+        legacy = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                              phase="train", prepared="off")
+        np.testing.assert_array_equal(
+            flipped,
+            legacy.__getitem__(0, rng=np.random.default_rng((seed, 0, 0)))[1])
+
+    def test_worker_threads_with_cache_identical(self, synth):
+        # loader threads share the cache: content must stay identical to
+        # the serial uncached path (thread-safety + determinism)
+        img_root, gt_root, _ = synth
+        base = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                            phase="train", prepared="off")
+        cached = CrowdDataset(img_root, gt_root, gt_downsample=8,
+                              phase="train", prepared="off",
+                              item_cache=ItemCache(1 << 30))
+        for epoch in range(2):
+            b0 = ShardedBatcher(base, 2, shuffle=True, seed=3,
+                                pad_multiple=64, num_workers=0)
+            b1 = ShardedBatcher(cached, 2, shuffle=True, seed=3,
+                                pad_multiple=64, num_workers=3)
+            try:
+                for s, p in zip(b0.epoch(epoch), b1.epoch(epoch)):
+                    np.testing.assert_array_equal(s.image, p.image)
+                    np.testing.assert_array_equal(s.dmap, p.dmap)
+            finally:
+                b1.close()
+
+
+class TestPrepareDataCLI:
+    def test_bake_verify_and_split_layout(self, tmp_path, monkeypatch,
+                                          capsys):
+        import sys as _sys
+
+        from tools import prepare_data
+
+        root = tmp_path / "setA"
+        for split in ("train", "test"):
+            make_synthetic_dataset(str(root / f"{split}_data"), 2,
+                                   sizes=((64, 64),), seed=4)
+        monkeypatch.setattr(_sys, "argv", [
+            "prepare_data.py", "--root", str(root), "--prepared",
+            "--no-gen", "--quiet"])
+        prepare_data.main()
+        for split in ("train", "test"):
+            assert os.path.isfile(os.path.join(
+                root, f"{split}_data", "ground_truth", "prepared",
+                MANIFEST_NAME))
+        monkeypatch.setattr(_sys, "argv", [
+            "prepare_data.py", "--root", str(root), "--verify-store"])
+        prepare_data.main()
+        assert "verified" in capsys.readouterr().out
+        # --prepared-out writes per-split subdirs the CLIs probe
+        # (cli/common.py split_prepared_spec joins <out>/<split>)
+        out = tmp_path / "stores"
+        monkeypatch.setattr(_sys, "argv", [
+            "prepare_data.py", "--root", str(root), "--prepared",
+            "--no-gen", "--quiet", "--prepared-out", str(out)])
+        prepare_data.main()
+        from can_tpu.cli.common import split_prepared_spec
+
+        for split in ("train", "test"):
+            spec = split_prepared_spec(str(out), split)
+            assert os.path.isfile(os.path.join(spec, MANIFEST_NAME))

@@ -265,3 +265,89 @@ class TestMultihostMetadataGate:
         assert not self._present(monkeypatch,
                                  {"JAX_COORDINATOR_ADDRESS": "x:1",
                                   "NUM_PROCESSES": "not-a-number"})
+
+
+class TestSlurmRendezvous:
+    """parallel/runtime.py::_slurm_rendezvous (VERDICT missing #3): derive
+    the coordinator from SLURM_NTASKS + the first nodelist host at the
+    fixed port; metadata that names a multi-task job but is incomplete is
+    FATAL — never a silent single-process fallback.  Pure env-dict calls:
+    no monkeypatching, no jax.distributed."""
+
+    def _rv(self, env):
+        from can_tpu.parallel.runtime import _slurm_rendezvous
+
+        return _slurm_rendezvous(env)
+
+    def test_full_metadata_derives_triple(self):
+        from can_tpu.parallel.runtime import SLURM_COORDINATOR_PORT
+
+        got = self._rv({"SLURM_NTASKS": "4",
+                        "SLURM_JOB_NODELIST": "node[001-004]",
+                        "SLURM_PROCID": "2"})
+        assert got == (f"node001:{SLURM_COORDINATOR_PORT}", 4, 2)
+
+    def test_port_keyed_on_job_id(self):
+        # two concurrent jobs whose first node coincides must NOT share a
+        # port (they would rendezvous into each other); every task of ONE
+        # job derives the same offset without communicating
+        from can_tpu.parallel.runtime import SLURM_COORDINATOR_PORT
+
+        env = {"SLURM_NTASKS": "2", "SLURM_JOB_NODELIST": "node001",
+               "SLURM_PROCID": "0"}
+        a = self._rv(dict(env, SLURM_JOB_ID="123456"))
+        b = self._rv(dict(env, SLURM_JOB_ID="123457"))
+        assert a[0] == f"node001:{SLURM_COORDINATOR_PORT + 456}"
+        assert a[0] != b[0]
+        # same job id -> same address on every task
+        assert a == self._rv(dict(env, SLURM_JOB_ID="123456",
+                                  SLURM_PROCID="0"))
+
+    def test_nodelist_forms(self):
+        from can_tpu.parallel.runtime import _first_slurm_host
+
+        assert _first_slurm_host("tpu-host003") == "tpu-host003"
+        assert _first_slurm_host("a,b,c") == "a"
+        assert _first_slurm_host("node[001-004]") == "node001"
+        assert _first_slurm_host("node[7,9-12]") == "node7"
+        # bracket group first, plain host after: the comma inside []
+        # must not split the first entry
+        assert _first_slurm_host("tpu[003-004,007],gpu2") == "tpu003"
+
+    def test_absent_metadata_is_none(self):
+        assert self._rv({}) is None
+        # salloc shell: nodelist without a launched task — not a job
+        assert self._rv({"SLURM_JOB_NODELIST": "node001"}) is None
+
+    def test_single_task_job_degrades(self):
+        assert self._rv({"SLURM_NTASKS": "1",
+                         "SLURM_JOB_NODELIST": "node001",
+                         "SLURM_PROCID": "0"}) is None
+
+    def test_salloc_shell_degrades_with_notice(self, capsys):
+        # salloc exports NTASKS/NODELIST but never PROCID (only srun sets
+        # it, per task) — a shell inside a multi-task allocation is NOT a
+        # launched task and must run single-process, loudly
+        assert self._rv({"SLURM_NTASKS": "4",
+                         "SLURM_JOB_NODELIST": "node[001-004]"}) is None
+        assert "salloc" in capsys.readouterr().out
+
+    def test_partial_metadata_is_fatal(self):
+        import pytest
+
+        # a LAUNCHED task (PROCID set) missing its nodelist: incomplete
+        with pytest.raises(RuntimeError, match="incomplete"):
+            self._rv({"SLURM_NTASKS": "4", "SLURM_PROCID": "0"})
+        # a launched task id without a task count: incomplete, not absent
+        with pytest.raises(RuntimeError, match="incomplete"):
+            self._rv({"SLURM_PROCID": "3"})
+
+    def test_garbage_values_are_fatal_not_silent(self):
+        import pytest
+
+        with pytest.raises(RuntimeError, match="SLURM_NTASKS"):
+            self._rv({"SLURM_NTASKS": "many"})
+        with pytest.raises(RuntimeError, match="SLURM_PROCID"):
+            self._rv({"SLURM_NTASKS": "2",
+                      "SLURM_JOB_NODELIST": "a,b",
+                      "SLURM_PROCID": "zero"})

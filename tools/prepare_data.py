@@ -1,12 +1,22 @@
-"""Offline ground-truth density-map generation CLI.
+"""Offline ground-truth density-map generation + prepared-store bake CLI.
 
-The reference's data_preparation/k_nearest_gaussian_kernel.py __main__ block
-(:58-83) with its hardcoded Windows path replaced by a flag, its 1-point
-crash fixed, and the O(people x H x W) per-point full-image filtering
-replaced by exact windowed stamping (see can_tpu/data/density.py).
+Density generation: the reference's
+data_preparation/k_nearest_gaussian_kernel.py __main__ block (:58-83) with
+its hardcoded Windows path replaced by a flag, its 1-point crash fixed, and
+the O(people x H x W) per-point full-image filtering replaced by exact
+windowed stamping (see can_tpu/data/density.py).
+
+Prepared store (``--prepared``): additionally bake the snapped
+1/8-resolution density maps the training loader actually consumes (both
+flip orientations + a staleness manifest — see can_tpu/data/prepared.py),
+so every epoch loads ~27 KB/item instead of re-resizing ~1.7 MB/item.
+``--verify-store`` re-reads an existing store and checks every CRC.
 
 Usage:
     python tools/prepare_data.py --root data/part_A            # train+test
+    python tools/prepare_data.py --root data/part_A --prepared # + 1/8 store
+    python tools/prepare_data.py --root data/part_A --prepared --no-gen
+    python tools/prepare_data.py --root data/part_A --verify-store
     python tools/prepare_data.py --dirs data/part_A/train_data/images
 """
 
@@ -19,6 +29,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _gt_dir_for(img_dir: str) -> str:
+    """ShanghaiTech convention (mirrors data/density.py): the density maps
+    of ``.../images`` live in the sibling ``.../ground_truth``."""
+    parent, leaf = os.path.split(os.path.normpath(img_dir))
+    return os.path.join(parent, "ground_truth") if leaf == "images" else img_dir
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--root", default=None,
@@ -27,10 +44,24 @@ def main() -> None:
                     help="explicit image directories")
     ap.add_argument("--k", type=int, default=3, help="nearest neighbours")
     ap.add_argument("--sigma-scale", type=float, default=0.1)
+    ap.add_argument("--prepared", action="store_true",
+                    help="bake the snapped 1/8-resolution density store "
+                         "(both flip orientations + manifest) next to each "
+                         "split's ground_truth — the loader's fast path")
+    ap.add_argument("--prepared-out", default=None,
+                    help="prepared-store root override (default "
+                         "<ground_truth>/prepared): stores land in "
+                         "per-split subdirs <out>/<split> — the layout "
+                         "the CLIs' --prepared-root probes")
+    ap.add_argument("--no-gen", action="store_true",
+                    help="skip density-map generation (the .npy files "
+                         "already exist); only bake/verify the store")
+    ap.add_argument("--verify-store", action="store_true",
+                    help="re-read an existing prepared store and check "
+                         "every file's CRC against the manifest")
+    ap.add_argument("--gt-downsample", type=int, default=8)
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
-
-    from can_tpu.data import generate_density_maps
 
     dirs = list(args.dirs or [])
     if args.root:
@@ -40,9 +71,55 @@ def main() -> None:
                 dirs.append(d)
     if not dirs:
         raise SystemExit("no image directories given (use --root or --dirs)")
-    n = generate_density_maps(dirs, k=args.k, sigma_scale=args.sigma_scale,
-                              verbose=not args.quiet)
-    print(f"wrote {n} density maps")
+
+    # order: generate -> bake -> verify, each gated by its flag, so
+    # `--prepared --verify-store` bakes THEN checks (a verify-only
+    # invocation, --verify-store without --prepared, skips generation)
+    if not args.no_gen and not (args.verify_store and not args.prepared):
+        from can_tpu.data import generate_density_maps
+
+        n = generate_density_maps(dirs, k=args.k,
+                                  sigma_scale=args.sigma_scale,
+                                  verbose=not args.quiet)
+        print(f"wrote {n} density maps")
+
+    if args.prepared:
+        from can_tpu.data.prepared import write_store
+
+        for img_dir in dirs:
+            gt_dir = _gt_dir_for(img_dir)
+            root = write_store(img_dir, gt_dir,
+                               _store_out(args, img_dir, gt_dir),
+                               gt_downsample=args.gt_downsample,
+                               verbose=not args.quiet)
+            print(f"baked prepared store at {root}")
+
+    if args.verify_store:
+        from can_tpu.data.prepared import PreparedStore
+
+        for img_dir in dirs:
+            gt_dir = _gt_dir_for(img_dir)
+            root = (_store_out(args, img_dir, gt_dir)
+                    or PreparedStore.default_root(gt_dir))
+            store = PreparedStore.open(root, gt_dmap_root=gt_dir,
+                                       gt_downsample=args.gt_downsample)
+            checked = store.verify()
+            print(f"verified {checked} prepared files under {root}")
+
+
+def _store_out(args, img_dir: str, gt_dir: str):
+    """--prepared-out resolution: ALWAYS per-split subdirs — named
+    'train'/'test' (the split dir minus '_data', else the parent dir
+    name) — because that is the one layout the CLIs' --prepared-root can
+    address (cli/common.py split_prepared_spec joins <path>/<split>); a
+    direct single-dir store would be baked but unreachable through the
+    flag that exists to consume it."""
+    if not args.prepared_out:
+        return None
+    split = os.path.basename(os.path.dirname(os.path.normpath(img_dir)))
+    if split.endswith("_data"):
+        split = split[: -len("_data")]
+    return os.path.join(args.prepared_out, split)
 
 
 if __name__ == "__main__":
