@@ -446,17 +446,19 @@ def resolve_launch_cost_px(spec, *, announce: bool = False) -> float:
 
 
 def make_bucketed_train_step(apply_fn, optimizer, mesh, *, compute_dtype,
-                             policy):
+                             policy, health_metrics: bool = False):
     """Data-parallel train step with per-bucket remat dispatch: two jitted
     step objects (remat on/off); jit caches per batch shape under each, so
     every bucket runs the cheapest variant the ``policy`` (make_remat_policy)
     allows.  Shared by the train CLI and bench_suite so the bench measures
-    exactly the CLI's dispatch."""
+    exactly the CLI's dispatch.  health_metrics: in-program grad/update
+    norms for the run-health layer (default off — identical programs)."""
     from can_tpu.parallel import make_dp_train_step
 
     steps = {flag: make_dp_train_step(apply_fn, optimizer, mesh,
                                       compute_dtype=compute_dtype,
-                                      remat=flag)
+                                      remat=flag,
+                                      health_metrics=health_metrics)
              for flag in (False, True)}
 
     def train_step(state, batch):

@@ -103,6 +103,15 @@ def parse_args(argv=None):
                    help="write serve.request/serve.batch/serve.reject "
                         "JSONL here (tools/telemetry_report.py summarises)")
     p.add_argument("--telemetry-heartbeat-s", type=float, default=60.0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus-text /metrics + /healthz on this "
+                        "port (0 = ephemeral): the service's /stats "
+                        "counters (requests, rejects, queue depth, "
+                        "latency percentiles) in the SAME format and "
+                        "labels as the train CLI's gauges — one scrape "
+                        "config covers training and serving")
+    p.add_argument("--metrics-host", type=str, default="127.0.0.1",
+                   help="bind address for --metrics-port")
     return p.parse_args(argv)
 
 
@@ -156,10 +165,13 @@ def main(argv=None) -> int:
     apply_platform(args)
     init_runtime()
     apply_compile_cache(args, announce=True)
-    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
-                                           trace_window=None)
+    telemetry, heartbeat, exporter = build_telemetry(
+        args, host_id=process_index(), trace_window=None)
     try:
         service = build_service(args, telemetry=telemetry)
+        if exporter is not None:
+            # serve's counters in the same scrape as the bus gauges
+            exporter.add_stats_source("serve", service.stats)
         with service:
             httpd = serve_http(service, host=args.host, port=args.port)
             print(f"[serve] listening on http://{args.host}:{args.port} "
@@ -174,6 +186,8 @@ def main(argv=None) -> int:
     finally:
         if heartbeat is not None:
             heartbeat.close()
+        if exporter is not None:
+            exporter.close()
         telemetry.close()
         shutdown_runtime()
 

@@ -119,6 +119,13 @@ def parse_args(argv=None):
                         "schema as the train CLI; one file per host)")
     p.add_argument("--telemetry-heartbeat-s", type=float, default=60.0,
                    help="heartbeat event interval (with --telemetry-dir)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus-text /metrics + /healthz on this "
+                        "port during the eval (0 = ephemeral; see the "
+                        "train CLI — long high-res evals are worth "
+                        "watching too)")
+    p.add_argument("--metrics-host", type=str, default="127.0.0.1",
+                   help="bind address for --metrics-port")
     p.add_argument("--max-buckets", type=int, default=24,
                    help="compile budget for --pad-multiple auto (distinct "
                         "(shape x batch-size) programs)")
@@ -214,10 +221,11 @@ def main(argv=None) -> int:
     apply_platform(args)
     init_runtime()
     apply_compile_cache(args)
-    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
-                                           trace_window=trace_window)
+    telemetry, heartbeat, exporter = build_telemetry(
+        args, host_id=process_index(), trace_window=trace_window)
     # loop instrumentation only when something consumes it (see train CLI)
-    loop_tel = telemetry if (args.telemetry_dir or trace_window) else None
+    loop_tel = telemetry if (args.telemetry_dir or trace_window
+                             or exporter is not None) else None
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -384,6 +392,8 @@ def main(argv=None) -> int:
     finally:
         if heartbeat is not None:
             heartbeat.close()
+        if exporter is not None:
+            exporter.close()
         telemetry.close()
         shutdown_runtime()  # the reference leaks its process group (SURVEY §3.1)
 

@@ -157,6 +157,17 @@ def parse_args(argv=None):
                    help="heartbeat event interval (with --telemetry-dir): "
                         "a hung run leaves a last-known-good timestamp; "
                         "<= 0 disables the heartbeat thread")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus-text /metrics + /healthz on this "
+                        "port (0 = ephemeral): live step/loss/grad-norm "
+                        "gauges, compile/stall/alert counters — fed by an "
+                        "in-memory sink on the telemetry bus; also enables "
+                        "the run-health detectors (health.alert events). "
+                        "Default off: no exporter thread, no extra "
+                        "instrumentation")
+    p.add_argument("--metrics-host", type=str, default="127.0.0.1",
+                   help="bind address for --metrics-port (0.0.0.0 to let "
+                        "a fleet scraper reach every host)")
     p.add_argument("--max-steps-per-epoch", type=int, default=0,
                    help="truncate epochs (smoke tests); 0 = full epoch")
     p.add_argument("--platform", type=str, default="default",
@@ -251,13 +262,25 @@ def validate_trace_args(args):
 def build_telemetry(args, *, host_id: int, trace_window, logger=None):
     """The CLIs' shared wiring: per-host JSONL sink (``--telemetry-dir``),
     MetricLogger adapter (epoch scalars keep flowing to stdout/wandb
-    unchanged), optional step-range trace window, heartbeat thread.
-    Returns ``(telemetry, heartbeat_or_None)``."""
+    unchanged), optional step-range trace window, heartbeat thread, and —
+    with ``--metrics-port`` — an in-memory gauge sink plus the live
+    Prometheus exporter (obs/exporter.py).  Returns
+    ``(telemetry, heartbeat_or_None, exporter_or_None)``."""
     from can_tpu import obs
 
     trace = (obs.StepTraceWindow(args.profile_dir, *trace_window)
              if trace_window else None)
     extra = [obs.MetricLoggerSink(logger)] if logger is not None else []
+    exporter = None
+    metrics_port = getattr(args, "metrics_port", None)
+    if metrics_port is not None:
+        gauges = obs.GaugeSink()
+        extra.append(gauges)
+        exporter = obs.MetricsExporter(
+            gauges, host=getattr(args, "metrics_host", "127.0.0.1"),
+            port=metrics_port).start()
+        print(f"[metrics] /metrics + /healthz on "
+              f"http://{exporter.host}:{exporter.port}")
     if args.telemetry_dir:
         tel = obs.open_host_telemetry(args.telemetry_dir, host_id=host_id,
                                       extra_sinks=extra, trace=trace)
@@ -266,9 +289,11 @@ def build_telemetry(args, *, host_id: int, trace_window, logger=None):
     tel.emit("run", config={k: v for k, v in vars(args).items()
                             if isinstance(v, (str, int, float, bool,
                                               type(None)))})
+    # heartbeat whenever an artifact OR a live scraper consumes it (the
+    # exporter's last_heartbeat_ts gauge is the probe's staleness signal)
     hb = (obs.Heartbeat(tel, args.telemetry_heartbeat_s)
-          if args.telemetry_dir else None)
-    return tel, hb
+          if (args.telemetry_dir or exporter is not None) else None)
+    return tel, hb, exporter
 
 
 def apply_compile_cache(args, *, announce: bool = False) -> None:
@@ -347,6 +372,12 @@ def main(argv=None) -> int:
             if drifted:
                 print(f"[resume] config drift allowed: {', '.join(drifted)}")
     trace_window = validate_trace_args(args)
+    # per-step instrumentation is on when ANY consumer exists: JSONL
+    # artifact, trace window, or a live /metrics scraper.  Known before
+    # any runtime work so the step builders can compile the health
+    # scalars in; a default run keeps the exact pre-PR programs.
+    instrument = bool(args.telemetry_dir or trace_window
+                      or args.metrics_port is not None)
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
@@ -518,7 +549,8 @@ def main(argv=None) -> int:
         cache = SpatialStepCache(
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
                                           compute_dtype=compute_dtype,
-                                          remat=remat_policy(hw)))
+                                          remat=remat_policy(hw),
+                                          health_metrics=instrument))
 
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)
@@ -529,7 +561,8 @@ def main(argv=None) -> int:
 
         train_step = make_bucketed_train_step(apply_fn, optimizer, mesh,
                                               compute_dtype=compute_dtype,
-                                              policy=remat_policy)
+                                              policy=remat_policy,
+                                              health_metrics=instrument)
         eval_step = make_dp_eval_step(apply_fn, mesh,
                                       compute_dtype=compute_dtype)
     # batches are H-sharded when sp > 1 (train and eval both)
@@ -544,9 +577,9 @@ def main(argv=None) -> int:
     # reach stdout/wandb exactly as before), heartbeat thread, and the
     # step-range trace trigger.  With --trace-steps the whole-run
     # profile_trace below is disarmed — the window replaces it.
-    telemetry, heartbeat = build_telemetry(args, host_id=process_index(),
-                                           trace_window=trace_window,
-                                           logger=logger)
+    telemetry, heartbeat, exporter = build_telemetry(
+        args, host_id=process_index(), trace_window=trace_window,
+        logger=logger)
     # prepared-store status: one data.prepared event per split (the
     # one-line fallback record the store contract requires), echoed on
     # stdout for the main process
@@ -557,10 +590,15 @@ def main(argv=None) -> int:
             f"{split}={'on' if d.prepared_note['active'] else 'legacy(' + str(d.prepared_note['reason']) + ')'}"
             for split, d in (("train", train_ds), ("test", test_ds))))
     # the LOOPS are instrumented only when something consumes per-step
-    # data (JSONL sink or a trace window): the default run's hot path
-    # must stay byte-identical — the bus still carries the once-per-epoch
-    # metrics row to the MetricLogger either way
-    loop_tel = telemetry if (args.telemetry_dir or trace_window) else None
+    # data (JSONL sink, trace window, or live /metrics scraper): the
+    # default run's hot path must stay byte-identical — the bus still
+    # carries the once-per-epoch metrics row to the MetricLogger either way
+    loop_tel = telemetry if instrument else None
+    # the run-health detectors ride the instrumented loop's windowed
+    # fetch: live health.alert events on the same bus, zero extra syncs
+    from can_tpu.obs import HealthMonitor
+
+    health = HealthMonitor(telemetry) if loop_tel is not None else None
     best_mae = float("inf") if resumed_best is None else float(resumed_best)
     try:
         with profile_trace(None if trace_window
@@ -574,7 +612,8 @@ def main(argv=None) -> int:
                 state, stats = train_one_epoch(
                     train_step, state, batches, put_fn=put, epoch=epoch,
                     show_progress=main_proc,
-                    total=steps_per_epoch, telemetry=loop_tel)
+                    total=steps_per_epoch, telemetry=loop_tel,
+                    health=health)
                 # every epoch (not only eval epochs): loss, throughput, and
                 # the shape count — a bucketing misconfiguration shows up
                 # here as distinct_shapes churning mid-run
@@ -630,6 +669,8 @@ def main(argv=None) -> int:
         ckpt.close()
         if heartbeat is not None:
             heartbeat.close()
+        if exporter is not None:
+            exporter.close()
         telemetry.close()  # stops a still-open trace window, closes sinks
         logger.finish()
         shutdown_runtime()  # the reference never calls its cleanup()

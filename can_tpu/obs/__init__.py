@@ -27,7 +27,14 @@ from .bus import (
     Telemetry,
     open_host_telemetry,
 )
-from .report import format_report, read_events, summarize
+from .exporter import GaugeSink, MetricsExporter, render_stats
+from .health import (
+    EwmaMadDetector,
+    HealthMonitor,
+    PlateauDetector,
+    ThroughputDetector,
+)
+from .report import format_report, read_events, read_events_counted, summarize
 from .sources import (
     Heartbeat,
     RecompileTracker,
@@ -39,19 +46,27 @@ from .trace import StepTraceWindow, parse_trace_steps
 
 __all__ = [
     "EVENT_KINDS",
+    "EwmaMadDetector",
+    "GaugeSink",
     "Heartbeat",
+    "HealthMonitor",
     "JsonlSink",
     "MetricLoggerSink",
+    "MetricsExporter",
+    "PlateauDetector",
     "RecompileTracker",
     "StallClock",
     "StdoutSink",
     "StepTraceWindow",
     "Telemetry",
+    "ThroughputDetector",
     "device_memory_snapshot",
     "emit_memory",
     "format_report",
     "open_host_telemetry",
     "parse_trace_steps",
     "read_events",
+    "read_events_counted",
+    "render_stats",
     "summarize",
 ]

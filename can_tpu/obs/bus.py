@@ -43,11 +43,15 @@ import numpy as np
 # rejections.  data.* kinds come from the host data pipeline
 # (can_tpu/data/prepared.py): per-split prepared-store status (active or
 # the fallback reason) and per-epoch decoded-item-cache counters.
+# health.* kinds come from the run-health layer (can_tpu/obs/health.py):
+# live anomaly alerts (spike / plateau / nan_precursor / nan /
+# throughput_regression / stall_budget) and the per-epoch rollup.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
-               "data.prepared", "data.cache")
+               "data.prepared", "data.cache",
+               "health.alert", "health.summary")
 
 
 def _jsonable(v):

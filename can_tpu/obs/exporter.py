@@ -1,0 +1,277 @@
+"""Prometheus-text ``/metrics`` + ``/healthz`` for live runs.
+
+The JSONL artifact answers "what happened"; a scrape endpoint answers
+"what is happening".  ``GaugeSink`` is an ordinary bus sink — it derives
+in-memory gauges/counters from the SAME events every other sink sees (no
+new instrumentation, no extra hot-path work beyond a dict update per
+event) — and ``MetricsExporter`` serves them over a stdlib
+``ThreadingHTTPServer`` (the ``serve/service.py`` pattern: threads hold
+blocked scrapers; the run owns the device).
+
+One scrape config covers training AND serving: the serve CLI registers
+``CountService.stats()`` as an extra source, so its request/reject/queue
+counters come out in the same Prometheus text at the same port.
+
+Exposition format (text/plain; version=0.0.4)::
+
+    # TYPE can_tpu_loss gauge
+    can_tpu_loss 0.1234
+    # TYPE can_tpu_events_total counter
+    can_tpu_events_total{kind="step_window"} 42
+
+Nothing here touches the default path: no ``--metrics-port``, no
+``GaugeSink``, no server thread.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import statistics
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# serve/service.py stats() keys that are monotonic counts (rendered with
+# the Prometheus ``_total`` suffix); the rest of the dict is gauges
+_SERVE_COUNTER_KEYS = frozenset(
+    {"submitted", "completed", "rejected", "batches", "batch_slots",
+     "batch_valid", "compile_count"})
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if isinstance(v, float) else str(v)
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def render_prometheus(gauges: Dict[str, float],
+                      counters: Dict[Tuple[str, tuple], float]) -> str:
+    """One exposition block: plain gauges, then labelled counters.
+    ``counters`` keys are ``(name, ((label, value), ...))``."""
+    lines = []
+    for name in sorted(gauges):
+        v = gauges[name]
+        if v is None:
+            continue
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt_value(v)}")
+    by_name: Dict[str, list] = {}
+    for (name, labels), v in counters.items():
+        by_name.setdefault(name, []).append((labels, v))
+    for name in sorted(by_name):
+        lines.append(f"# TYPE {name} counter")
+        for labels, v in sorted(by_name[name], key=lambda kv: kv[0]):
+            if labels:
+                lab = ",".join(f'{k}="{str(val)}"' for k, val in labels)
+                lines.append(f"{name}{{{lab}}} {_fmt_value(v)}")
+            else:
+                lines.append(f"{name} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+class GaugeSink:
+    """Bus sink -> in-memory Prometheus state.
+
+    Gauges (last value wins): run-local ``can_tpu_step``, the per-window
+    ``can_tpu_loss`` / ``can_tpu_grad_norm`` / ``can_tpu_update_norm``
+    means the loop folds into ``step_window`` events, window median step
+    time, per-epoch scalars (``can_tpu_train_loss``, ``can_tpu_mae``,
+    ...), heartbeat timestamp, peak HBM / host RSS.  Counters: events by
+    kind, steps/images, compiles (+seconds), stall seconds, health alerts
+    by signal+kind.  Thread-safe: the bus emits under its own lock from
+    several threads, and scrape threads read concurrently."""
+
+    def __init__(self, prefix: str = "can_tpu"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[Tuple[str, tuple], float] = {}
+
+    # -- bus sink protocol ----------------------------------------------
+    def emit(self, event: dict) -> None:
+        kind = event.get("kind", "?")
+        p = event.get("payload", {})
+        pre = self.prefix
+        with self._lock:
+            self._count((f"{pre}_events_total", (("kind", kind),)))
+            if kind == "step_window":
+                if event.get("step") is not None:
+                    self._gauges[f"{pre}_step"] = event["step"]
+                self._count((f"{pre}_steps_total", ()),
+                            float(p.get("steps", 0)))
+                self._count((f"{pre}_images_total", ()),
+                            float(p.get("images", 0.0)))
+                samples = p.get("samples_s", ())
+                if samples:
+                    self._gauges[f"{pre}_step_time_p50_s"] = float(
+                        statistics.median(samples))
+                for key in ("loss", "grad_norm", "update_norm"):
+                    if key in p:
+                        self._gauges[f"{pre}_{key}"] = float(p[key])
+            elif kind == "compile":
+                self._count((f"{pre}_compiles_total", ()))
+                self._count((f"{pre}_compile_seconds_total", ()),
+                            float(p.get("seconds", 0.0)))
+            elif kind == "stall":
+                self._count((f"{pre}_stall_seconds_total", ()),
+                            float(p.get("seconds", 0.0)))
+            elif kind == "epoch":
+                if event.get("step") is not None:
+                    self._gauges[f"{pre}_epoch"] = event["step"]
+                for k, v in p.items():
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        self._gauges[f"{pre}_{_sanitize(k)}"] = float(v)
+            elif kind == "heartbeat":
+                self._gauges[f"{pre}_last_heartbeat_ts"] = event.get("ts")
+            elif kind == "memory":
+                for d in p.get("devices", ()):
+                    for key in ("peak_bytes_in_use", "bytes_in_use"):
+                        if key in d:
+                            g = f"{pre}_peak_hbm_bytes"
+                            self._gauges[g] = max(
+                                self._gauges.get(g, 0), int(d[key]))
+                            break
+                rss = p.get("host_rss_mb")
+                if rss is not None:
+                    self._gauges[f"{pre}_host_rss_mb"] = float(rss)
+            elif kind == "health.alert":
+                self._count((f"{pre}_health_alerts_total",
+                             (("signal", str(p.get("signal", "?"))),
+                              ("kind", str(p.get("alert", "?"))))))
+
+    def close(self) -> None:
+        pass  # in-memory only; the exporter's lifecycle is the CLI's
+
+    # -- reads -----------------------------------------------------------
+    def _count(self, key: Tuple[str, tuple], by: float = 1.0) -> None:
+        self._counters[key] = self._counters.get(key, 0) + by
+
+    def alerts_total(self) -> int:
+        with self._lock:
+            return int(sum(v for (name, _), v in self._counters.items()
+                           if name == f"{self.prefix}_health_alerts_total"))
+
+    def render(self) -> str:
+        with self._lock:
+            return render_prometheus(dict(self._gauges),
+                                     dict(self._counters))
+
+
+def render_stats(stats: dict, *, prefix: str = "can_tpu_serve",
+                 counter_keys=_SERVE_COUNTER_KEYS) -> str:
+    """Flat numeric stats dict -> Prometheus text (serve's ``/stats``
+    counters in the same scrape).  Count-like keys get ``_total``; bools
+    become 0/1 gauges; Nones and nested values are skipped."""
+    gauges: Dict[str, float] = {}
+    counters: Dict[Tuple[str, tuple], float] = {}
+    for k, v in stats.items():
+        if v is None or not isinstance(v, (int, float, bool)):
+            continue
+        name = f"{prefix}_{_sanitize(k)}"
+        if k in counter_keys and not isinstance(v, bool):
+            counters[(f"{name}_total", ())] = v
+        else:
+            gauges[name] = v
+    return render_prometheus(gauges, counters)
+
+
+class MetricsExporter:
+    """The scrape endpoint: ``GET /metrics`` (gauge sink + every
+    registered stats source) and ``GET /healthz`` (liveness + the alert
+    counter, so a probe can distinguish "up" from "up but screaming").
+
+    ``port=0`` binds an ephemeral port (tests); ``.port`` is the bound
+    one.  ``start()`` launches a daemon thread — scrapes must never block
+    the train loop, and a hung scraper dies with the process."""
+
+    def __init__(self, gauges: GaugeSink, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gauges = gauges
+        self.host = host
+        self.port = int(port)
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def add_stats_source(self, prefix: str,
+                         stats_fn: Callable[[], dict]) -> None:
+        """Expose a flat numeric stats dict (e.g. ``CountService.stats``)
+        as ``can_tpu_<prefix>_*`` lines in the same scrape."""
+        self._sources[prefix] = stats_fn
+
+    def render(self) -> str:
+        parts = [self.gauges.render()]
+        for prefix, fn in sorted(self._sources.items()):
+            try:
+                parts.append(render_stats(fn(),
+                                          prefix=f"can_tpu_{prefix}"))
+            except Exception as e:  # noqa: BLE001 — a dead source must
+                # not kill the scrape: the OTHER metrics still matter
+                parts.append(f"# source {prefix} failed: "
+                             f"{type(e).__name__}\n")
+        return "".join(p for p in parts if p)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "MetricsExporter":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # scrapes are not news
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                from urllib.parse import urlparse
+
+                path = urlparse(self.path).path
+                if path == "/metrics":
+                    self._send(200, exporter.render().encode(),
+                               _PROM_CONTENT_TYPE)
+                elif path == "/healthz":
+                    body = json.dumps(
+                        {"ok": True,
+                         "alerts_total": exporter.gauges.alerts_total()})
+                    self._send(200, body.encode(), "application/json")
+                else:
+                    self._send(404, json.dumps(
+                        {"error": f"no such path: {path}"}).encode(),
+                        "application/json")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port=0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="can-tpu-metrics-exporter")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -10,13 +10,20 @@ reader's job is post-mortem triage of exactly such runs.
 from __future__ import annotations
 
 import json
-from typing import Iterable, List, Optional
+from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
 
-def read_events(path: str) -> List[dict]:
+def read_events_counted(path: str) -> Tuple[List[dict], int]:
+    """Read a telemetry JSONL, returning ``(events, skipped_lines)``.
+
+    A run killed mid-write leaves a torn final line — exactly the runs
+    this reader triages — so undecodable lines are skipped, but COUNTED:
+    the note distinguishes "clean artifact" from "crashed mid-event"
+    (and more than one skip flags real corruption, not a torn tail)."""
     events = []
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -25,8 +32,12 @@ def read_events(path: str) -> List[dict]:
             try:
                 events.append(json.loads(line))
             except json.JSONDecodeError:
-                continue  # torn tail write of a killed run
-    return events
+                skipped += 1  # torn tail write of a killed run
+    return events, skipped
+
+
+def read_events(path: str) -> List[dict]:
+    return read_events_counted(path)[0]
 
 
 def _percentile(samples: List[float], q: float) -> Optional[float]:
@@ -61,6 +72,8 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_queue_depth_max = None
     cache_last: Optional[dict] = None
     prepared_splits: dict = {}
+    alerts: dict = {}
+    health_last: Optional[dict] = None
     for e in events:
         kind = e.get("kind", "?")
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -112,6 +125,11 @@ def summarize(events: Iterable[dict]) -> dict:
             reason = str(p.get("reason", "?"))
             serve_rejects[reason] = (serve_rejects.get(reason, 0)
                                      + int(p.get("count", 1)))
+        elif kind == "health.alert":
+            tag = f"{p.get('signal', '?')}/{p.get('alert', '?')}"
+            alerts[tag] = alerts.get(tag, 0) + 1
+        elif kind == "health.summary":
+            health_last = p  # per-epoch rollup: the last wins
         elif kind == "data.cache":
             cache_last = p  # counters are cumulative: the last wins
         elif kind == "data.prepared":
@@ -158,6 +176,11 @@ def summarize(events: Iterable[dict]) -> dict:
                                  if cache_last else None),
         "cache_evictions": (cache_last.get("evictions")
                             if cache_last else None),
+        # run-health layer (can_tpu/obs/health.py); zeros/Nones when off
+        "health_alerts": sum(alerts.values()),
+        "health_alerts_by_kind": dict(sorted(alerts.items())),
+        "health_suppressed": (health_last.get("suppressed")
+                              if health_last else None),
     }
 
 
@@ -206,6 +229,13 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
              f"{_fmt(summary['cache_bytes'])} / {_fmt(cap)}"
              f" (evictions={_fmt(summary['cache_evictions'])})"),
         ]
+    if summary.get("health_alerts"):
+        by_kind = summary.get("health_alerts_by_kind") or {}
+        rows.append(("health alerts",
+                     " ".join(f"{k}={n}" for k, n in by_kind.items())))
+        if summary.get("health_suppressed"):
+            rows.append(("alerts suppressed",
+                         _fmt(summary["health_suppressed"])))
     if summary.get("serve_requests") or summary.get("serve_rejects"):
         rejects = summary.get("serve_rejects_by_reason") or {}
         rows += [

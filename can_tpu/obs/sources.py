@@ -143,9 +143,13 @@ class Heartbeat:
     One event fires immediately at start (the last-known-good baseline a
     short run still records), then every interval until ``close()``.
     Payload carries the run-local step counter, so a wedged run's artifact
-    says how far it got, not just when it died.  ``interval_s <= 0``
-    disables the thread entirely (NOT a floor — a 0 interval flooding
-    ~100 fsync'd events/second into the file would be worse than none)."""
+    says how far it got, not just when it died — plus a monotonic ``seq``
+    and the process-start ``start_ts``, so a reader of an APPENDED file
+    (same run dir, new process) can tell a restarted process (``start_ts``
+    changes, ``seq`` resets) from a resumed stream (``tools/run_monitor.py``
+    counts the restarts).  ``interval_s <= 0`` disables the thread entirely
+    (NOT a floor — a 0 interval flooding ~100 fsync'd events/second into
+    the file would be worse than none)."""
 
     def __init__(self, telemetry, interval_s: float = 60.0,
                  *, start: bool = True):
@@ -153,6 +157,7 @@ class Heartbeat:
         self.interval_s = float(interval_s)
         self._stop = threading.Event()
         self._t0 = time.time()
+        self._seq = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="can-tpu-heartbeat")
         if start and self.interval_s > 0:
@@ -161,7 +166,9 @@ class Heartbeat:
     def _run(self) -> None:
         while True:
             self._tel.emit("heartbeat",
-                           uptime_s=round(time.time() - self._t0, 3))
+                           uptime_s=round(time.time() - self._t0, 3),
+                           seq=self._seq, start_ts=round(self._t0, 3))
+            self._seq += 1
             if self._stop.wait(self.interval_s):
                 return
 

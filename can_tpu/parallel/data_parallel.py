@@ -65,17 +65,20 @@ def dp_size(mesh: Mesh) -> int:
 
 def make_dp_train_step(apply_fn: Callable, optimizer, mesh: Mesh, *,
                        compute_dtype=None, donate: bool = True,
-                       remat: bool = False, remat_policy=None) -> Callable:
+                       remat: bool = False, remat_policy=None,
+                       health_metrics: bool = False) -> Callable:
     """Jitted data-parallel ``(state, batch_dict) -> (state, metrics)``.
 
     state is replicated, batch sharded on ``data``; the state buffers are
     donated (params updated in place — halves peak HBM vs the reference's
     separate grad buffers).  remat_policy: see make_train_step (selective
-    remat via models/cannet.py checkpoint_name tags).
+    remat via models/cannet.py checkpoint_name tags).  health_metrics
+    adds grad/update global-norm scalars to metrics (obs/health.py).
     """
     step = make_train_step(apply_fn, optimizer, grad_divisor=dp_size(mesh),
                            compute_dtype=compute_dtype, remat=remat,
-                           remat_policy=remat_policy)
+                           remat_policy=remat_policy,
+                           health_metrics=health_metrics)
     repl = NamedSharding(mesh, P())
     return jax.jit(
         step,

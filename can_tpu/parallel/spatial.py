@@ -192,7 +192,8 @@ def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
 
 def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
                        compute_dtype=None, donate: bool = True,
-                       remat: bool = False) -> Callable:
+                       remat: bool = False,
+                       health_metrics: bool = False) -> Callable:
     """Jitted train step with BOTH data and spatial parallelism.
 
     Batch dict layout: image (B, H, W, 3), dmap/pixel_mask (B, H/8, W/8, 1),
@@ -277,6 +278,14 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
                 "loss": sse,
                 "num_valid": lax.psum(jnp.sum(batch["sample_mask"]), DATA_AXIS),
             }
+            if health_metrics:
+                # grads/updates are already psum'd (replicated across
+                # shards), so these norms are the same global quantities
+                # the dp step computes — shard-invariant by construction
+                from can_tpu.train.steps import global_norm
+
+                metrics["grad_norm"] = global_norm(grads)
+                metrics["update_norm"] = global_norm(updates)
             return state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state,
                 batch_stats=(jax.lax.stop_gradient(new_stats)

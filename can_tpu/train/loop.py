@@ -77,15 +77,20 @@ def _arm_telemetry(telemetry, step_fn, *, name: str):
 
 
 def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
-                          epoch: int, seconds: float) -> None:
+                          epoch: int, seconds: float,
+                          health=None) -> None:
     """Epoch-boundary events: stall accounting + device-memory snapshot +
-    the step-time reservoir summary (per-shape breakdown included)."""
+    the step-time reservoir summary (per-shape breakdown included).
+    ``health`` escalates over-budget starvation into a ``health.alert``."""
     from can_tpu.obs import emit_memory
 
+    stall_frac = (round(stall.seconds / seconds, 4) if seconds > 0 else 0.0)
     telemetry.emit("stall", phase=phase, epoch=epoch,
                    seconds=round(stall.seconds, 4), count=stall.count,
-                   frac_of_epoch=round(stall.seconds / seconds, 4)
-                   if seconds > 0 else 0.0)
+                   frac_of_epoch=stall_frac)
+    if health is not None:
+        health.on_stall(seconds=stall.seconds, frac=stall_frac,
+                        epoch=epoch, phase=phase)
     telemetry.emit("step_window", phase=phase, epoch=epoch, steps=0,
                    samples_s=[], closes_epoch=True,
                    **timer.percentiles(), shapes=timer.shape_summary())
@@ -93,25 +98,30 @@ def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
 
 
 def _emit_step_window(telemetry, samples, *, steps: int, phase: str,
-                      epoch: int, t_window: float, images: float) -> float:
+                      epoch: int, t_window: float, images: float,
+                      **scalars) -> float:
     """One ``step_window`` event per metric-flush window.  The samples are
     host-side step intervals (no per-step fence — that would serialise the
     dispatch pipeline); the flush step absorbs the device sync, so the
     window's sample SUM is honest wall time while individual samples are
     dispatch-biased.  ``steps`` counts every step in the window; samples
     exclude first-call compiles (attributed by their own compile events),
-    so ``len(samples_s)`` can be smaller.  Returns the new window start."""
+    so ``len(samples_s)`` can be smaller.  ``scalars`` carries the
+    window's fetched health means (loss / grad_norm / update_norm) so the
+    /metrics gauges update mid-epoch without any new event kind.  Returns
+    the new window start."""
     now = time.perf_counter()
     telemetry.emit("step_window", phase=phase, epoch=epoch, steps=steps,
                    seconds=round(now - t_window, 4), images=images,
-                   samples_s=[round(s, 6) for s in samples])
+                   samples_s=[round(s, 6) for s in samples], **scalars)
     return now
 
 
 def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                     put_fn: Callable, epoch: int = 0, show_progress: bool = True,
                     check_finite: bool = True, total: Optional[int] = None,
-                    prefetch: int = 2, check_every: int = 8, telemetry=None):
+                    prefetch: int = 2, check_every: int = 8, telemetry=None,
+                    health=None):
     """Run one epoch; returns (state, EpochStats).
 
     train_step: jitted (state, batch_dict) -> (state, metrics).
@@ -126,9 +136,17 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
       ``compile`` (new batch signature -> first-call time), ``step_window``
       (per metric-flush window), and epoch-boundary ``stall``/``memory``
       events.  None keeps the hot path untouched.
+    health: optional ``obs.HealthMonitor``; fed the fetched per-step
+      scalars (loss per image + the in-program grad/update norms when the
+      step computes them), each window's step-time samples, and the
+      epoch's stall fraction — emitting ``health.alert`` events on the
+      same bus.  Requires ``telemetry`` (ignored without it): detection
+      rides the windowed fetch, never adds a sync.
     """
     from can_tpu.data.prefetch import prefetch_to_device
 
+    if telemetry is None:
+        health = None
     train_step, timer, stall = _arm_telemetry(telemetry, train_step,
                                               name="train_step")
     loss_sum = 0.0
@@ -157,42 +175,67 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
         pending.append(metrics)
         steps += 1
         if len(pending) >= max(check_every, 1):
-            loss_sum, img_sum = _flush(pending, loss_sum, img_sum,
-                                       check_finite, epoch, steps)
+            loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum,
+                                            check_finite, epoch, steps,
+                                            health=health,
+                                            collect=telemetry is not None)
             pending = []
             if telemetry is not None:
+                win_samples = timer.drain_window()
+                if health is not None:
+                    health.on_window(win_samples, epoch=epoch, phase="train")
                 t_window = _emit_step_window(
-                    telemetry, timer.drain_window(),
+                    telemetry, win_samples,
                     steps=steps - flushed_steps, phase="train",
                     epoch=epoch, t_window=t_window,
-                    images=img_sum - flushed_img)
+                    images=img_sum - flushed_img, **win)
                 flushed_img = img_sum
                 flushed_steps = steps
             if show_progress and hasattr(it, "set_postfix") and img_sum:
                 it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
-    loss_sum, img_sum = _flush(pending, loss_sum, img_sum, check_finite,
-                               epoch, steps)
+    loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum, check_finite,
+                                    epoch, steps, health=health,
+                                    collect=telemetry is not None)
     seconds = time.perf_counter() - t0
     if telemetry is not None:
         tail = timer.drain_window()
         if tail or steps > flushed_steps:  # partial trailing window
+            if health is not None:
+                health.on_window(tail, epoch=epoch, phase="train")
             _emit_step_window(telemetry, tail, steps=steps - flushed_steps,
                               phase="train", epoch=epoch, t_window=t_window,
-                              images=img_sum - flushed_img)
+                              images=img_sum - flushed_img, **win)
         _emit_epoch_telemetry(telemetry, timer, stall, phase="train",
-                              epoch=epoch, seconds=seconds)
+                              epoch=epoch, seconds=seconds, health=health)
+        if health is not None:
+            health.epoch_summary(epoch)
     stats = EpochStats(loss_sum / max(img_sum, 1.0), seconds=seconds,
                        images=img_sum, steps=steps,
                        distinct_shapes=len(shapes))
     return state, stats
 
 
-def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count):
-    """Fetch a window of async step metrics in one device_get."""
+def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count,
+           health=None, collect=False):
+    """Fetch a window of async step metrics in one device_get.
+
+    Returns ``(loss_sum, img_sum, window_scalars)``; ``window_scalars``
+    holds the window's mean loss-per-image (and grad/update norms when
+    the step computes them, see ``make_train_step health_metrics``) for
+    the ``step_window`` payload — empty unless ``collect`` (telemetry on),
+    so the uninstrumented flush does exactly the work it did before.
+    ``health`` gets every fetched step's scalars, and — on the abort
+    path — the non-finite loss BEFORE ``NonFiniteLossError`` propagates,
+    so the run's last bus event says why it died."""
     window = len(pending)
-    for metrics in jax.device_get(pending):
+    collect = collect or health is not None
+    win: dict = {}
+    for i, metrics in enumerate(jax.device_get(pending)):
         loss = float(metrics["loss"])
+        step_no = step_count - window + i + 1
         if check_finite and not math.isfinite(loss):
+            if health is not None:
+                health.on_nonfinite(loss, epoch=epoch, step=step_no)
             # every host computes the same replicated loss, so every host
             # raises: a clean global abort, not the reference's one-rank
             # exit + deadlock.  Detection is windowed (one sync per
@@ -203,9 +246,27 @@ def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count):
                 f"{window} steps (<= step {step_count}; metric checks are "
                 f"windowed — pass check_every=1 to train_one_epoch to "
                 f"pinpoint); aborting all hosts")
+        n = float(metrics["num_valid"])
         loss_sum += loss
-        img_sum += float(metrics["num_valid"])
-    return loss_sum, img_sum
+        img_sum += n
+        if collect:
+            per_img = loss / max(n, 1.0)
+            gn = (float(metrics["grad_norm"])
+                  if "grad_norm" in metrics else None)
+            un = (float(metrics["update_norm"])
+                  if "update_norm" in metrics else None)
+            for key, v in (("loss", per_img), ("grad_norm", gn),
+                           ("update_norm", un)):
+                if v is not None:
+                    acc = win.setdefault(key, [0, 0.0])
+                    acc[0] += 1
+                    acc[1] += v
+            if health is not None:
+                health.on_step_metrics(loss_per_img=per_img, grad_norm=gn,
+                                       update_norm=un, epoch=epoch,
+                                       step=step_no)
+    return loss_sum, img_sum, {k: round(total / cnt, 8)
+                               for k, (cnt, total) in win.items()}
 
 
 def evaluate(eval_step: Callable, params, batches: Iterable, *,

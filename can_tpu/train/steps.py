@@ -70,13 +70,25 @@ class NonFiniteLossError(RuntimeError):
     non-finite value and every host raises — a clean global abort."""
 
 
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over every leaf of a pytree (optax.global_norm without the
+    import): the health layer's gradient/update magnitude signal."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
+
+
 def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
                     compute_dtype=None, remat: bool = False,
-                    remat_policy=None) -> Callable:
+                    remat_policy=None, health_metrics: bool = False) -> Callable:
     """Returns ``train_step(state, batch) -> (state, metrics)`` (un-jitted).
 
     batch: dict with image/dmap/pixel_mask/sample_mask (see data/batching.py).
     metrics: dict of scalars (loss = global SSE before divisor, num_valid).
+    health_metrics: also return ``grad_norm``/``update_norm`` (global L2,
+    computed in-program so they ride the loop's windowed metric fetch with
+    no extra device syncs — obs/health.py's divergence signals).  Default
+    off: the metrics tree, and therefore the compiled program, stays
+    byte-identical to before for uninstrumented runs.
     remat: rematerialise the forward in backward (``jax.checkpoint``) —
     trades ~1/3 more FLOPs for not keeping every VGG activation in HBM,
     enabling much larger batches / resolutions per chip.
@@ -130,6 +142,9 @@ def make_train_step(apply_fn: Callable, optimizer, *, grad_divisor: int = 1,
             "loss": sse,
             "num_valid": jnp.sum(batch["sample_mask"]),
         }
+        if health_metrics:
+            metrics["grad_norm"] = global_norm(grads)
+            metrics["update_norm"] = global_norm(updates)
         return new_state, metrics
 
     return train_step

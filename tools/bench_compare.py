@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Regression gate over two BENCH_SUITE_*.json artifacts.
+
+The suite's host-pipeline entries record ``spread_pct`` — the measured
+min/max spread over round-robin repeats (bench_suite.py) — precisely so a
+later run can tell a real regression from the 6–30% host-load noise the
+2-vCPU bench box exhibits.  This tool is that comparison: per metric, the
+noise floor is the LARGER of the two runs' recorded spreads (floored at
+``--default-spread-pct`` for entries that don't record one), and a change
+beyond the floor in the bad direction exits nonzero — so bench runs
+become CI-gateable instead of eyeballed.
+
+    python tools/bench_compare.py BENCH_SUITE_r07.json BENCH_SUITE_r08.json
+    python tools/bench_compare.py old.json new.json --json
+
+Direction comes from the record's ``unit``: rates (``*/sec``) regress
+DOWN, durations (``seconds``) regress UP.  Metrics present in only one
+file are reported (``added``/``removed``) but never gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_suite(path: str) -> dict:
+    """``metric -> record`` from a BENCH_SUITE_*.json ({"results": [...]})
+    or a bare JSONL of result records (bench stdout piped to a file)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        # suite doc, bare list, or a single-record artifact (BENCH_r*.json)
+        records = (doc.get("results", [doc]) if isinstance(doc, dict)
+                   else doc)
+    except json.JSONDecodeError:
+        records = [json.loads(line) for line in text.splitlines()
+                   if line.strip()]
+    out = {}
+    for r in records:
+        if isinstance(r, dict) and "metric" in r:
+            out[r["metric"]] = r
+    if not out:
+        raise SystemExit(f"no result records with a 'metric' key in {path}")
+    return out
+
+
+def _direction(unit: str) -> int:
+    """+1 when bigger is better (rates), -1 when smaller is (durations),
+    0 unknown (never gates)."""
+    u = (unit or "").lower()
+    if "/sec" in u or "/s" in u:
+        return +1
+    if u in ("seconds", "s", "ms"):
+        return -1
+    return 0
+
+
+def compare(old: dict, new: dict, *,
+            default_spread_pct: float = 10.0) -> list:
+    """Row per metric: verdict ``ok`` / ``regression`` / ``improved`` /
+    ``added`` / ``removed`` / ``incomparable``.  delta_pct is signed in
+    the metric's own units (positive = value went up)."""
+    rows = []
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        if o is None or n is None:
+            rows.append({"metric": metric,
+                         "verdict": "added" if o is None else "removed"})
+            continue
+        ov, nv = o.get("value"), n.get("value")
+        sign = _direction(n.get("unit", o.get("unit", "")))
+        if ov is None or nv is None or sign == 0 or ov == 0:
+            # null results (watchdog timeouts) and unknown units are
+            # reported, never silently gated on
+            rows.append({"metric": metric, "old": ov, "new": nv,
+                         "verdict": "incomparable"})
+            continue
+        floor_pct = max(float(o.get("spread_pct") or 0.0),
+                        float(n.get("spread_pct") or 0.0),
+                        float(default_spread_pct))
+        delta_pct = 100.0 * (nv - ov) / abs(ov)
+        worse = -sign * delta_pct  # positive = moved in the bad direction
+        if worse > floor_pct:
+            verdict = "regression"
+        elif -worse > floor_pct:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append({"metric": metric, "old": ov, "new": nv,
+                     "unit": n.get("unit", o.get("unit", "")),
+                     "delta_pct": round(delta_pct, 1),
+                     "floor_pct": round(floor_pct, 1),
+                     "verdict": verdict})
+    return rows
+
+
+def format_rows(rows: list) -> str:
+    width = max(len(r["metric"]) for r in rows)
+    lines = []
+    for r in rows:
+        if r["verdict"] in ("added", "removed"):
+            lines.append(f"{r['metric'].ljust(width)}  {r['verdict']}")
+            continue
+        if r["verdict"] == "incomparable":
+            lines.append(f"{r['metric'].ljust(width)}  "
+                         f"{r.get('old')} -> {r.get('new')}  incomparable")
+            continue
+        lines.append(
+            f"{r['metric'].ljust(width)}  "
+            f"{r['old']:>10.3f} -> {r['new']:>10.3f}  "
+            f"{r['delta_pct']:+6.1f}% (floor ±{r['floor_pct']:.1f}%)  "
+            f"{r['verdict'].upper() if r['verdict'] == 'regression' else r['verdict']}")
+    n_reg = sum(r["verdict"] == "regression" for r in rows)
+    lines.append(f"# {n_reg} regression(s) beyond the noise floor"
+                 if n_reg else "# no regressions beyond the noise floor")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("old", help="baseline BENCH_SUITE_*.json (or JSONL)")
+    p.add_argument("new", help="candidate BENCH_SUITE_*.json (or JSONL)")
+    p.add_argument("--default-spread-pct", type=float, default=10.0,
+                   help="noise floor for entries without a recorded "
+                        "spread_pct (the suite's measured spreads run "
+                        "6-30%% on the 2-vCPU bench host)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the comparison rows as JSON")
+    args = p.parse_args(argv)
+    rows = compare(load_suite(args.old), load_suite(args.new),
+                   default_spread_pct=args.default_spread_pct)
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(format_rows(rows))
+    return 1 if any(r["verdict"] == "regression" for r in rows) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Cross-host run monitor: join per-host telemetry, flag stragglers and
+dead hosts, roll up health alerts.
+
+The bus writes one ``telemetry.host{k}.jsonl`` per host with NO cross-host
+coordination (obs/bus.py); this tool is the offline/live join.  It works
+on a finished run dir (post-hoc triage) or a live one (``--follow`` tails
+the files and prints a status line per interval — the MegaScale-style
+fleet view: per-host step-time skew, heartbeat staleness, alert counts).
+
+    python tools/run_monitor.py runs/exp1/              # one-shot report
+    python tools/run_monitor.py runs/exp1/ --follow     # live status lines
+    python tools/run_monitor.py runs/exp1/ --json       # machine-readable
+
+Detection:
+
+* straggler — a host whose recent median step time exceeds the fleet's
+  fastest host by ``--skew-factor`` (default 1.5x).  Lockstep training
+  runs at the SLOWEST host's pace, so one straggler taxes every chip.
+* dead host — last heartbeat older than ``--stale-after-s`` relative to
+  the fleet's newest event (post-hoc) or the wall clock (``--follow``).
+  Restarted processes are distinguished from resumed streams by the
+  heartbeat payload's ``start_ts``/``seq`` (obs/sources.py).
+* alerts — ``health.alert`` rollup per host, by ``signal/alert`` kind.
+
+Pure host-side file reading — no JAX import, safe on any machine the
+artifacts were copied to (same contract as tools/telemetry_report.py).
+Exit code: 0 healthy, 1 when any straggler/dead host/alert is found
+(one-shot mode), so a babysitter script can page on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from can_tpu.obs.report import read_events_counted  # noqa: E402
+
+_HOST_RE = re.compile(r"telemetry\.host(\d+)\.jsonl$")
+
+
+def discover_hosts(run_dir: str) -> dict:
+    """``host_id -> path`` for every per-host file in the run dir."""
+    hosts = {}
+    for path in glob.glob(os.path.join(run_dir, "telemetry.host*.jsonl")):
+        m = _HOST_RE.search(path)
+        if m:
+            hosts[int(m.group(1))] = path
+    return dict(sorted(hosts.items()))
+
+
+def analyze_host(events, *, skipped: int = 0,
+                 recent_windows: int = 8) -> dict:
+    """One host's vital signs from its event stream.
+
+    ``recent_step_p50_s`` pools the last ``recent_windows`` step_window
+    events' samples — the RECENT pace (what the fleet is waiting on now),
+    not the whole-run average a long warmup would bias."""
+    last_ts = None
+    last_hb_ts = None
+    hb_seq = None
+    starts = []
+    steps = 0
+    alerts: dict = {}
+    stall_s = 0.0
+    windows = []  # (ts, samples) per step_window event
+    epochs = set()
+    for e in events:
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            last_ts = ts if last_ts is None else max(last_ts, ts)
+        kind = e.get("kind")
+        p = e.get("payload", {})
+        if kind == "heartbeat":
+            if isinstance(ts, (int, float)):
+                last_hb_ts = (ts if last_hb_ts is None
+                              else max(last_hb_ts, ts))
+            if "seq" in p:
+                hb_seq = p["seq"]
+            st = p.get("start_ts")
+            if st is not None and (not starts or starts[-1] != st):
+                starts.append(st)
+        elif kind == "step_window":
+            steps += int(p.get("steps", 0))
+            windows.append(p.get("samples_s", ()))
+            if p.get("epoch") is not None:
+                epochs.add(p["epoch"])
+        elif kind == "stall":
+            stall_s += float(p.get("seconds", 0.0))
+        elif kind == "health.alert":
+            tag = f"{p.get('signal', '?')}/{p.get('alert', '?')}"
+            alerts[tag] = alerts.get(tag, 0) + 1
+    recent = [float(s) for w in windows[-recent_windows:] for s in w]
+    p50 = statistics.median(recent) if recent else None
+    return {
+        "events": len(events),
+        "skipped_lines": skipped,
+        "last_ts": last_ts,
+        "last_heartbeat_ts": last_hb_ts,
+        "heartbeat_seq": hb_seq,
+        "restarts": max(0, len(starts) - 1),
+        "steps": steps,
+        "epochs": len(epochs),
+        "recent_step_p50_s": p50,
+        "stall_s": round(stall_s, 3),
+        "alerts": dict(sorted(alerts.items())),
+        "alerts_total": sum(alerts.values()),
+    }
+
+
+def analyze_run(host_stats: dict, *, now=None, stale_after_s: float = 180.0,
+                skew_factor: float = 1.5) -> dict:
+    """Fleet verdict over per-host vitals (``analyze_host`` outputs).
+
+    ``now=None`` (post-hoc) anchors staleness at the fleet's NEWEST event:
+    a finished healthy run — where every host stopped together — reads
+    healthy, while a host that died mid-run lags the survivors' tail.
+    Live callers pass ``time.time()``."""
+    if now is None:
+        now = max((h["last_ts"] for h in host_stats.values()
+                   if h["last_ts"] is not None), default=0.0)
+    stragglers = []
+    dead = []
+    paces = {hid: h["recent_step_p50_s"] for hid, h in host_stats.items()
+             if h["recent_step_p50_s"]}
+    fastest = min(paces.values()) if len(paces) >= 2 else None
+    for hid, h in sorted(host_stats.items()):
+        if fastest is not None and hid in paces \
+                and paces[hid] > skew_factor * fastest:
+            stragglers.append(hid)
+            h["straggler_skew"] = round(paces[hid] / fastest, 3)
+        ref = (h["last_heartbeat_ts"] if h["last_heartbeat_ts"] is not None
+               else h["last_ts"])
+        if ref is not None:
+            h["staleness_s"] = round(now - ref, 3)
+            if h["staleness_s"] > stale_after_s:
+                dead.append(hid)
+    alerts_total = sum(h["alerts_total"] for h in host_stats.values())
+    return {
+        "now": now,
+        "hosts": host_stats,
+        "n_hosts": len(host_stats),
+        "stragglers": stragglers,
+        "dead": dead,
+        "restarts": sum(h["restarts"] for h in host_stats.values()),
+        "alerts_total": alerts_total,
+        "ok": not stragglers and not dead and alerts_total == 0,
+    }
+
+
+def analyze_dir(run_dir: str, *, now=None, stale_after_s: float = 180.0,
+                skew_factor: float = 1.5, recent_windows: int = 8) -> dict:
+    hosts = discover_hosts(run_dir)
+    if not hosts:
+        raise SystemExit(f"no telemetry.host*.jsonl files in {run_dir}")
+    stats = {}
+    for hid, path in hosts.items():
+        events, skipped = read_events_counted(path)
+        stats[hid] = analyze_host(events, skipped=skipped,
+                                  recent_windows=recent_windows)
+        stats[hid]["path"] = path
+    return analyze_run(stats, now=now, stale_after_s=stale_after_s,
+                       skew_factor=skew_factor)
+
+
+class HostTail:
+    """Incremental JSONL reader for --follow: remembers the byte offset
+    and keeps a partial trailing line in a buffer, so each poll costs
+    O(new bytes) instead of re-parsing a multi-day run's whole file.  A
+    line without its newline yet is a write IN PROGRESS, not a torn tail
+    — it stays buffered until complete (only a decode failure on a
+    COMPLETE line counts as skipped).  File truncation (rotation) resets
+    the tail."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self._buf = ""
+        self.events: list = []
+        self.skipped = 0
+
+    def poll(self) -> None:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return  # transiently unreadable; next poll retries
+        if size < self.offset:  # truncated/rotated underneath us
+            self.offset, self._buf = 0, ""
+            self.events, self.skipped = [], 0
+        with open(self.path) as f:
+            f.seek(self.offset)
+            chunk = f.read()
+            self.offset = f.tell()
+        *lines, self._buf = (self._buf + chunk).split("\n")
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self.events.append(json.loads(line))
+            except json.JSONDecodeError:
+                self.skipped += 1
+
+
+def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
+               skew_factor: float, recent_windows: int):
+    """One --follow poll: discover hosts (new ones can appear as a pod
+    spins up), advance each tail incrementally, analyze.  Returns None
+    while the dir has no telemetry files yet — the watch waits for the
+    run instead of dying before it starts."""
+    hosts = discover_hosts(run_dir)
+    if not hosts:
+        return None
+    stats = {}
+    for hid, path in hosts.items():
+        tail = tails.get(hid)
+        if tail is None or tail.path != path:
+            tail = tails[hid] = HostTail(path)
+        tail.poll()
+        stats[hid] = analyze_host(tail.events, skipped=tail.skipped,
+                                  recent_windows=recent_windows)
+        stats[hid]["path"] = path
+    return analyze_run(stats, now=time.time(),
+                       stale_after_s=stale_after_s, skew_factor=skew_factor)
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v:.4g}s"
+
+
+def format_report(run: dict) -> str:
+    lines = [f"# run monitor — {run['n_hosts']} host(s), "
+             f"{'HEALTHY' if run['ok'] else 'UNHEALTHY'}"]
+    for hid, h in sorted(run["hosts"].items()):
+        flags = []
+        if hid in run["stragglers"]:
+            flags.append(f"STRAGGLER x{h.get('straggler_skew')}")
+        if hid in run["dead"]:
+            flags.append(f"DEAD (stale {h.get('staleness_s'):.0f}s)")
+        if h["restarts"]:
+            flags.append(f"restarted x{h['restarts']}")
+        if h["skipped_lines"]:
+            flags.append(f"torn lines {h['skipped_lines']}")
+        lines.append(
+            f"host {hid}: steps={h['steps']} "
+            f"step p50={_fmt_s(h['recent_step_p50_s'])} "
+            f"stall={h['stall_s']}s "
+            f"stale={_fmt_s(h.get('staleness_s'))} "
+            f"alerts={h['alerts_total']}"
+            + (f" [{', '.join(flags)}]" if flags else ""))
+        for tag, n in h["alerts"].items():
+            lines.append(f"  alert {tag}: {n}")
+    if run["stragglers"]:
+        lines.append(f"stragglers: hosts {run['stragglers']} (lockstep "
+                     f"training runs at the slowest host's pace)")
+    if run["dead"]:
+        lines.append(f"dead hosts: {run['dead']} (no heartbeat within "
+                     f"the staleness bound)")
+    return "\n".join(lines)
+
+
+def format_status_line(run: dict) -> str:
+    """One --follow line: the fleet's pulse, greppable."""
+    paces = [h["recent_step_p50_s"] for h in run["hosts"].values()
+             if h["recent_step_p50_s"]]
+    pace = f"{max(paces):.3f}s" if paces else "-"
+    return (f"[monitor] hosts={run['n_hosts']} "
+            f"ok={'yes' if run['ok'] else 'NO'} "
+            f"steps={sum(h['steps'] for h in run['hosts'].values())} "
+            f"slowest_p50={pace} "
+            f"stragglers={run['stragglers'] or '-'} "
+            f"dead={run['dead'] or '-'} "
+            f"alerts={run['alerts_total']}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_dir", help="directory holding telemetry.host*.jsonl")
+    p.add_argument("--follow", action="store_true",
+                   help="keep re-reading and print one status line per "
+                        "interval (staleness vs the wall clock)")
+    p.add_argument("--interval-s", type=float, default=10.0,
+                   help="--follow poll interval")
+    p.add_argument("--stale-after-s", type=float, default=180.0,
+                   help="heartbeat age that marks a host dead (pick ~3x "
+                        "the run's --telemetry-heartbeat-s)")
+    p.add_argument("--skew-factor", type=float, default=1.5,
+                   help="recent median step time beyond this multiple of "
+                        "the fastest host flags a straggler")
+    p.add_argument("--recent-windows", type=int, default=8,
+                   help="step_window events pooled for the recent pace")
+    p.add_argument("--json", action="store_true",
+                   help="emit the analysis dict as JSON (one-shot mode)")
+    args = p.parse_args(argv)
+    kw = dict(stale_after_s=args.stale_after_s,
+              skew_factor=args.skew_factor,
+              recent_windows=args.recent_windows)
+    if args.follow:
+        tails: dict = {}
+        waiting = False
+        try:
+            while True:
+                run = follow_dir(args.run_dir, tails, **kw)
+                if run is None:
+                    if not waiting:  # say it once, then poll quietly
+                        waiting = True
+                        print(f"[monitor] waiting for telemetry.host*.jsonl "
+                              f"in {args.run_dir} ...", flush=True)
+                else:
+                    waiting = False
+                    print(format_status_line(run), flush=True)
+                time.sleep(args.interval_s)
+        except (KeyboardInterrupt, BrokenPipeError):
+            # ^C or a closed pipe (`... --follow | head`) ends the watch
+            return 0
+    run = analyze_dir(args.run_dir, **kw)
+    if args.json:
+        print(json.dumps(run))
+    else:
+        print(format_report(run))
+    return 0 if run["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

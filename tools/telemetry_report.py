@@ -22,7 +22,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from can_tpu.obs.report import format_report, read_events, summarize  # noqa: E402
+from can_tpu.obs.report import (  # noqa: E402
+    format_report,
+    read_events_counted,
+    summarize,
+)
 
 
 def resolve_paths(target: str) -> list:
@@ -44,11 +48,18 @@ def main(argv=None) -> int:
                    help="emit the summary dict(s) as JSON instead of a table")
     args = p.parse_args(argv)
     for path in resolve_paths(args.target):
-        summary = summarize(read_events(path))
+        events, skipped = read_events_counted(path)
+        summary = summarize(events)
         if args.json:
-            print(json.dumps({"path": path, **summary}))
+            print(json.dumps({"path": path, "skipped_lines": skipped,
+                              **summary}))
         else:
             print(format_report(summary, title=path))
+            if skipped:
+                # a torn final line is the signature of a killed run —
+                # exactly what this report triages, so say so
+                print(f"(skipped {skipped} torn/truncated line(s) — "
+                      f"crashed-run artifact)")
             print()
     return 0
 
