@@ -80,7 +80,124 @@ def measure(ndev_use: int, *, b: int, h: int, w: int, steps: int,
     return local_b * steps / dt
 
 
+MEASURED_V5E_IMG_PER_S = 94.5   # 1-chip 576x768 b16 bf16 (BENCH_SUITE_r05)
+# v5e ICI: 4 links x 400 Gbps = 1600 Gbps aggregate per chip; a
+# bidirectional ring all-reduce drives 2 links -> ~100 GB/s effective.
+# Stated as an assumption in the artifact, not hidden in the code.
+V5E_ICI_EFFECTIVE_GBS = 100.0
+# fraction of the all-reduce XLA fails to overlap with the backward pass
+# (GSPMD overlaps most of it; 0.5 is deliberately pessimistic)
+ALLREDUCE_EXPOSED_FRAC = 0.5
+
+
+def scaling_model(*, dps=(1, 2, 4, 8, 16, 32, 64), per_chip_batch=16,
+                  shape=(576, 768), n_images=300, chips_per_host=4,
+                  base_img_per_s=MEASURED_V5E_IMG_PER_S):
+    """Model-predicted dp=1..64 efficiency (VERDICT r5 item 8): the
+    hardware-blocked '1->64 chips' axis gets a number built from the
+    MEASURED single-chip rate plus the two scale costs this framework
+    can compute exactly without chips:
+
+    * collective overhead — ring all-reduce of the real parameter count
+      over v5e ICI (2(dp-1)/dp * grad_bytes / bw), derated by the
+      exposed (non-overlapped) fraction;
+    * plan overhead — the batch planner run for the TRUE dp
+      configuration (global batch = per_chip_batch * dp, quantum = lcm
+      of dp and host count, v5e HBM cap): a fixed-size varres dataset at
+      growing global batch pays growing padding/fill, and that is a
+      schedule property this host computes bit-exactly (data/planner.py).
+
+    Each dp row is a prediction, labelled as such; the harness's
+    measured sweep replaces it the day a pod slice exists.  Returns the
+    artifact dict (also written by --model / SCALING_MODEL env)."""
+    import math as _math
+
+    from bench_suite import SynthVarResDataset
+    from can_tpu.cli.common import (
+        hbm_bytes_for_device_kind,
+        max_launch_pixels,
+    )
+    import jax
+
+    from can_tpu.data import ShardedBatcher
+    from can_tpu.models import cannet_init
+
+    params = cannet_init(jax.random.key(0))
+    grad_bytes = sum(x.size * 4 for x in jax.tree_util.tree_leaves(params))
+    px = shape[0] * shape[1]
+    t_comp = per_chip_batch / base_img_per_s  # seconds/step/chip, measured
+    ds = SynthVarResDataset(n_images)
+    rows = []
+    base_overhead = None
+    for dp in dps:
+        hosts = max(1, dp // chips_per_host)
+        quantum = _math.lcm(dp, hosts)
+        cap = max_launch_pixels(
+            bf16=True, shards=dp,
+            hbm_bytes=hbm_bytes_for_device_kind("TPU v5e"))
+        b = ShardedBatcher(ds, per_chip_batch * dp, shuffle=True, seed=0,
+                           pad_multiple="auto", max_buckets=24,
+                           remnant_sizes=True, batch_quantum=quantum,
+                           launch_cost_px=0.05e6, max_launch_px=cap)
+        overhead = b.schedule_overhead(0)
+        if base_overhead is None:
+            base_overhead = overhead
+        eff_plan = (1 + base_overhead) / (1 + overhead)
+        t_ar = (2 * (dp - 1) / dp) * grad_bytes / (V5E_ICI_EFFECTIVE_GBS * 1e9)
+        eff_coll = t_comp / (t_comp + ALLREDUCE_EXPOSED_FRAC * t_ar)
+        eff = eff_plan * eff_coll
+        rows.append({
+            "dp": dp,
+            "predicted_efficiency": round(eff, 4),
+            "predicted_img_per_s": round(base_img_per_s * dp * eff, 1),
+            "plan_efficiency": round(eff_plan, 4),
+            "collective_efficiency": round(eff_coll, 4),
+            "schedule_overhead": round(overhead, 4),
+            "programs": b.program_count(0),
+            "batches_per_epoch": b.batches_per_epoch(0),
+            "global_batch": per_chip_batch * dp,
+            "batch_quantum": quantum,
+        })
+    return {
+        "kind": "scaling_model",
+        "note": "PREDICTED dp scaling (no pod slice in this environment; "
+                "VERDICT r5 item 8): measured 1-chip rate x modelled "
+                "plan + collective efficiencies.  Plan overhead is exact "
+                "(the planner runs the real dp config on the bench "
+                "varres distribution, n_images fixed at "
+                f"{n_images} — a fixed dataset at growing global batch "
+                "is the pessimistic case); the collective term assumes "
+                f"a ring all-reduce of {grad_bytes / 1e6:.1f} MB f32 "
+                f"grads over {V5E_ICI_EFFECTIVE_GBS:.0f} GB/s effective "
+                f"ICI with {ALLREDUCE_EXPOSED_FRAC:.0%} exposed.",
+        "base_img_per_s": base_img_per_s,
+        "per_chip_batch": per_chip_batch,
+        "shape": list(shape),
+        "n_images": n_images,
+        "grad_bytes": grad_bytes,
+        "results": rows,
+    }
+
+
 def main() -> None:
+    import sys
+
+    model_out = os.environ.get("BENCH_SCALING_MODEL_OUT")
+    if "--model" in sys.argv[1:] or model_out:
+        # host-side prediction path: no devices needed beyond CPU init
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        doc = scaling_model()
+        out = model_out or "SCALING_MODEL_r08.json"
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"# wrote {out}")
+        for r in doc["results"]:
+            print(json.dumps({"metric": f"scaling_model_dp{r['dp']}",
+                              "value": r["predicted_efficiency"],
+                              "unit": "efficiency_pred",
+                              **{k: v for k, v in r.items() if k != "dp"}}))
+        return
     if os.environ.get("BENCH_SCALING_PLATFORM") == "cpu8":
         from __graft_entry__ import _ensure_cpu_flags
 

@@ -359,6 +359,22 @@ MODEL_MPX_PER_S = 42.0  # CANNet bf16 train-step device rate (v5e measured:
 # 94.9 img/s x 0.442 Mpx at 576x768) — converts dispatch ms to the
 # pixel-equivalents the remnant planner prices launches in
 
+# Per-launch cost in the DEVICE regime: what one extra launch costs when
+# dispatch is overlapped with compute (steps enqueued back-to-back, the
+# loop's windowed fetch amortising the sync) — the regime the bench
+# suite's steady-state compute numbers and a healthy prefetching train
+# loop run in.  The pixel-independent device work per launch is chiefly
+# the optimizer update (~300 MB param/momentum traffic ≈ 0.4 ms ≈ 0.017
+# Mpx on v5e, r5 calibration note above) plus executable switch + infeed
+# bookkeeping; 0.05 Mpx (~1.2 ms) is that with ~3x slack.  This is NOT
+# the dispatch-bound number: a host whose launches serialize on an RPC
+# (the 96 ms axon tunnel ⇒ ~4 Mpx) must price with --launch-cost-mpx
+# auto / the 2.0 default instead.  The distinction matters: the r5 bench
+# planned its varres schedule at tunnel pricing (2.0) and then quoted
+# the steady-state compute rate — paying 30.7% pixel overhead (b16) to
+# economise launches that regime gets nearly free (VERDICT r5 item 7).
+DEVICE_LAUNCH_COST_MPX = 0.05
+
 
 def measure_launch_cost_mpx(*, probes: int = 30,
                             device_rate_mpx_s: float = MODEL_MPX_PER_S) -> float:

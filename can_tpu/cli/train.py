@@ -232,6 +232,13 @@ def parse_args(argv=None):
                         "measures this host's dispatch overhead at "
                         "startup (sub-ms dispatch unlocks exact "
                         "straggler splits)")
+    p.add_argument("--plan-mode", choices=("cost", "legacy"), default="cost",
+                   help="batch-plan search: 'cost' (default) plans bucket "
+                        "boundaries, per-cell batch sizes, and remnant "
+                        "menus jointly under one cost model "
+                        "(area*slots + launch_cost*launches, HBM cap "
+                        "respected); 'legacy' is the pre-r8 heuristic "
+                        "planner, kept for A/B comparison")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -427,7 +434,7 @@ def main(argv=None) -> int:
                   min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
                   num_workers=num_workers, max_buckets=args.max_buckets,
                   remnant_sizes=not args.no_remnant_batches,
-                  batch_quantum=quantum,
+                  batch_quantum=quantum, plan_mode=args.plan_mode,
                   launch_cost_px=resolve_launch_cost_px(
                       args.launch_cost_mpx, announce=main_proc))
     # HBM agreed across hosts (min) ONCE: both the launch cap and the remat
@@ -463,7 +470,8 @@ def main(argv=None) -> int:
             print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
                   f"{n} distinct batch shapes, "
                   f"{b.program_count(0)} (shape x size) programs "
-                  f"(padding overhead {b.padding_overhead():.1%}, "
+                  f"(plan={b.plan_mode}, "
+                  f"padding overhead {b.padding_overhead():.1%}, "
                   f"schedule overhead {b.schedule_overhead(0):.1%})")
             if n > 4 * b.max_buckets:
                 print(f"[data] WARNING: {n} shapes will each compile a "
@@ -646,6 +654,15 @@ def main(argv=None) -> int:
                 # is GSPMD-reduced in-program, so every host computes the
                 # same number and host 0's MetricLogger reports it.
                 telemetry.emit("epoch", step=epoch, **epoch_metrics)
+                # planner decisions + schedule economics as live gauges
+                # (can_tpu_planner_* on /metrics): the plan is
+                # epoch-invariant so the values are steady — the gauge's
+                # job is to expose them to a scraper DURING the run, and
+                # realized_programs cross-checks the planner's predicted
+                # program count against what the step actually compiled
+                telemetry.emit("data.planner", step=epoch,
+                               realized_programs=stats.programs,
+                               **train_batcher.planner_stats(epoch))
                 if item_cache is not None:
                     # cumulative counters; the report reads the last event
                     telemetry.emit("data.cache", step=epoch,

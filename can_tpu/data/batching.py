@@ -17,6 +17,13 @@ distinct shape is a recompile.  TPU-first design:
 * **Masking.** A per-image validity flag plus a per-cell mask over the 1/8
   density grid make padded pixels and fill items contribute exactly zero to
   loss/metrics, so MSE-sum and MAE match the reference's per-image math.
+* **Cost-model batch planning.** In ladder+remnant mode the epoch's
+  launch plan — per-cell full-batch sizes (lowered under the HBM cap),
+  straggler covers at exact quantum-multiple sizes, group merges, and the
+  bucket boundaries themselves — is searched by one explicit objective,
+  ``area * padded_slots + launch_cost_px * n_launches``, in
+  ``data/planner.py`` (r8; ``plan_mode="legacy"`` keeps the pre-r8
+  heuristics for A/B).
 * **Lockstep host sharding.** Every process computes the SAME global batch
   schedule from the same seed (the dataset listing is sorted, the shuffle is
   keyed on (seed, epoch)), then materialises only its own slice of each
@@ -187,9 +194,18 @@ class ShardedBatcher:
                  remnant_sizes: bool = False,
                  batch_quantum: Optional[int] = None,
                  launch_cost_px: float = 2e6,
-                 max_launch_px: Optional[float] = None):
+                 max_launch_px: Optional[float] = None,
+                 plan_mode: str = "cost"):
+        if plan_mode not in ("cost", "legacy"):
+            raise ValueError(f"unknown plan_mode {plan_mode!r}")
         self.dataset = dataset
         self.batch_size = int(batch_size)
+        # "cost": the round-8 cost-model planner (data/planner.py) — exact
+        # remnant menus, full-cell batch-size pricing under the HBM cap,
+        # merge + local-search packing, and plan-cost-scored ladder grids.
+        # "legacy": the pre-r8 heuristics, kept bit-compatible as the
+        # ablation baseline (tools/plan_ablation.py) and escape hatch.
+        self.plan_mode = plan_mode
         # remnant sub-batches (ladder mode only): emit partial groups at a
         # small menu of sub-batch sizes instead of padding every straggler
         # group to the full global batch — see _partial_plan.  Off by
@@ -241,6 +257,17 @@ class ShardedBatcher:
         # pass a value compatible with their pad multiple
         self.min_bucket_h = min_bucket_h
         self.bucket_ladder: Optional[Tuple[Tuple[int, ...], Tuple[int, ...]]] = None
+        if self.remnant_sizes:
+            gbs = self.batch_size * self.process_count
+            if self.batch_quantum % self.process_count:
+                raise ValueError(
+                    f"batch_quantum ({self.batch_quantum}) must be a multiple "
+                    f"of process_count ({self.process_count}) so every host "
+                    f"slices an equal share of each sub-batch")
+            if gbs % self.batch_quantum:
+                raise ValueError(
+                    f"global batch ({gbs}) must be a multiple of "
+                    f"batch_quantum ({self.batch_quantum})")
         if pad_multiple == "auto":
             pad_multiple = self._resolve_auto_buckets(min_pad_multiple)
         # int -> same multiple both axes; (mh, mw) -> per-axis (spatial
@@ -255,17 +282,6 @@ class ShardedBatcher:
                         f"pad_multiple ({pad_multiple}) must be multiples of "
                         f"the density downsample factor ({self.ds})")
         self.pad_multiple = pad_multiple
-        if self.remnant_sizes:
-            gbs = self.batch_size * self.process_count
-            if self.batch_quantum % self.process_count:
-                raise ValueError(
-                    f"batch_quantum ({self.batch_quantum}) must be a multiple "
-                    f"of process_count ({self.process_count}) so every host "
-                    f"slices an equal share of each sub-batch")
-            if gbs % self.batch_quantum:
-                raise ValueError(
-                    f"global batch ({gbs}) must be a multiple of "
-                    f"batch_quantum ({self.batch_quantum})")
 
     def _item_shape(self, idx: int) -> Tuple[int, int]:
         hw = self._shape_cache.get(idx)
@@ -352,11 +368,26 @@ class ShardedBatcher:
             return None
         hs = [h for h, _ in shapes]
         ws = [w for _, w in shapes]
+        # cost mode + remnant sizes: boundary placement joins the plan
+        # search — every (kh, kw) grid with kh*kw <= max_buckets is
+        # descended and scored by the FULL plan cost of the schedule it
+        # induces (padding AND dead slots AND launches, under the HBM
+        # cap), because the padded-area score is blind to how counts
+        # split across cells: at b16 a padding-optimal 24-cell ladder
+        # leaves ~2.7 items per cell and the remnant covers/merges then
+        # cost 3x the padding they saved (BENCH_SUITE_r05, 30.7%
+        # schedule overhead).  Other modes keep the padded-area score
+        # over budget-saturating grids (pre-r8 behaviour).
+        cost_scored = self.plan_mode == "cost" and self.remnant_sizes
+        candidates = ((kh, kw)
+                      for kh in range(1, self.max_buckets + 1)
+                      for kw in ((range(1, self.max_buckets // kh + 1))
+                                 if cost_scored
+                                 else (self.max_buckets // kh,))
+                      if kw >= 1)
         best = None
-        for kh in range(1, self.max_buckets + 1):
-            kw = self.max_buckets // kh
-            if kw < 1:
-                continue
+        seen = set()
+        for kh, kw in candidates:
             # seed with quantiles, then coordinate-descend: each axis's
             # bounds are re-solved EXACTLY (weighted 1-D DP) holding the
             # other axis fixed — the weight of an item along H is its
@@ -372,12 +403,16 @@ class ShardedBatcher:
                 if (hb2, wb2) == (hb, wb):
                     break
                 hb, wb = hb2, wb2
-            if len(hb) * len(wb) > self.max_buckets:
+            if len(hb) * len(wb) > self.max_buckets or (hb, wb) in seen:
                 continue
-            pad_area = sum(_ceil_bound(h, hb) * _ceil_bound(w, wb)
-                           for h, w in shapes)
-            if best is None or pad_area < best[0]:
-                best = (pad_area, hb, wb)
+            seen.add((hb, wb))
+            if cost_scored:
+                score = self._ladder_plan_cost((hb, wb), shapes)
+            else:
+                score = sum(_ceil_bound(h, hb) * _ceil_bound(w, wb)
+                            for h, w in shapes)
+            if best is None or score < best[0]:
+                best = (score, hb, wb)
         if best is None:  # budget < any grid: one bucket covering the max
             hb = (-(-max(hs) // floor_h) * floor_h,)
             wb = (-(-max(ws) // floor_w) * floor_w,)
@@ -385,6 +420,35 @@ class ShardedBatcher:
         _, hb, wb = best
         self.bucket_ladder = (hb, wb)
         return None
+
+    def _ladder_plan_cost(self, ladder, shapes) -> float:
+        """Plan cost of the full epoch schedule a candidate ladder would
+        induce — the cost-mode score for ``_resolve_auto_buckets``.
+        Cell counts are vectorised (the sweep visits ~max_buckets*H(max_
+        buckets) candidate grids and may not cost O(n_items) Python per
+        grid on large datasets).  Warnings stay silent here (only the
+        CHOSEN ladder's plan warns, via _partial_plan)."""
+        from can_tpu.data.planner import GlobalPlanner
+
+        hb, wb = ladder
+        hs = np.asarray([h for h, _ in shapes])
+        ws = np.asarray([w for _, w in shapes])
+        hb_arr = np.asarray(hb)
+        wb_arr = np.asarray(wb)
+        hi = np.minimum(np.searchsorted(hb_arr, hs), len(hb) - 1)
+        wi = np.minimum(np.searchsorted(wb_arr, ws), len(wb) - 1)
+        snapped_h = hb_arr[hi]
+        if self.min_bucket_h is not None:
+            snapped_h = np.maximum(snapped_h, self.min_bucket_h)
+        cells, ncell = np.unique(
+            np.stack([snapped_h, wb_arr[wi]], axis=1),
+            axis=0, return_counts=True)
+        counts = {(int(h), int(w)): int(c)
+                  for (h, w), c in zip(cells, ncell)}
+        planner = GlobalPlanner(self._cost_model(),
+                                max_buckets=self.max_buckets,
+                                mode=self.plan_mode)
+        return planner.plan_with_fallback(counts).cost
 
     def padding_overhead(self) -> float:
         """Fraction of padded-batch pixels that are fill (0 = exact shapes).
@@ -440,16 +504,22 @@ class ShardedBatcher:
                               min_bucket_h=self.min_bucket_h)
 
     def _remnant_menu(self) -> Tuple[int, ...]:
-        """Legal sub-batch sizes (global units), descending: the full global
-        batch plus quantum * 2^j halvings.  Every size divides cleanly into
-        per-host slices and dp shards (batch_quantum contract)."""
-        gbs = self.batch_size * self.process_count
-        menu = {gbs}
-        s = self.batch_quantum
-        while s < gbs:
-            menu.add(s)
-            s *= 2
-        return tuple(sorted(menu, reverse=True))
+        """Legal sub-batch sizes (global units), descending — every size a
+        quantum multiple, so it divides cleanly into per-host slices and
+        dp shards (batch_quantum contract).  Cost mode: every quantum
+        multiple up to the global batch (exact-size remnant launches;
+        the program budget prunes).  Legacy: gbs + quantum * 2^j."""
+        from can_tpu.data.planner import remnant_menu
+
+        return remnant_menu(self.batch_size * self.process_count,
+                            self.batch_quantum, mode=self.plan_mode)
+
+    def _cost_model(self, menu: Optional[Tuple[int, ...]] = None):
+        from can_tpu.data.planner import PlanCostModel
+
+        return PlanCostModel(menu=menu or self._remnant_menu(),
+                             launch_cost_px=self.launch_cost_px,
+                             max_launch_px=self.max_launch_px)
 
     def _menu_for(self, key: Tuple[int, int],
                   menu: Tuple[int, ...]) -> Tuple[int, ...]:
@@ -460,215 +530,66 @@ class ShardedBatcher:
         loudly ONCE, because the cap's no-OOM promise no longer holds for
         that cell (the alternative, refusing the item, would silently
         drop data)."""
-        if self.max_launch_px is None:
-            return menu
-        area = key[0] * key[1]
-        kept = tuple(s for s in menu if s * area <= self.max_launch_px)
-        if not kept:
-            floor = min(menu)
+        model = self._cost_model(menu)
+        kept = model.fitting(key)
+        if self.max_launch_px is not None and not model.fits(key, min(menu)):
             if key not in self._cap_warned:
                 self._cap_warned.add(key)
                 print(f"[batching] WARNING: bucket {key[0]}x{key[1]} exceeds "
                       f"the per-launch pixel cap even at the minimum batch "
-                      f"{floor} ({floor * area / 1e6:.1f} Mpx > "
-                      f"{self.max_launch_px / 1e6:.1f} Mpx) — launching "
-                      f"anyway; expect HBM pressure (shrink batch_quantum "
-                      f"or image sizes)")
-            return (floor,)
+                      f"{min(menu)} ({min(menu) * key[0] * key[1] / 1e6:.1f} "
+                      f"Mpx > {self.max_launch_px / 1e6:.1f} Mpx) — "
+                      f"launching anyway; expect HBM pressure (shrink "
+                      f"batch_quantum or image sizes)")
         return kept
-
-    def _cell_gbs(self, key: Tuple[int, int], menu: Tuple[int, ...]) -> int:
-        """Full-batch size for this cell: the global batch, unless the
-        pixel cap forces a smaller launch."""
-        return max(self._menu_for(key, menu))
 
     @staticmethod
     def _decompose(n: int, menu: Tuple[int, ...], area: float = 1.0,
                    launch_cost: float = 0.0) -> Tuple[int, ...]:
-        """Cover ``n`` items with menu-size parts minimising
-        ``area * total_slots + launch_cost * n_parts`` — exact tiny DP
-        (n is at most a few global batches).
+        """Exact launch-size cover DP — see ``planner.decompose`` (moved
+        there in r8 so the cost model, the ablation tool, and the batcher
+        share one implementation; this alias keeps the planner's unit
+        surface stable)."""
+        from can_tpu.data.planner import decompose
 
-        ``launch_cost`` (pixel-equivalents per step launch) is what makes
-        the plan hardware-honest: with free launches the optimum is an
-        exact split (8+4+1 for 13), but a TPU step has a fixed dispatch/
-        overhead cost, so splitting a straggler group into several small
-        batches can cost more than the dead slots it saves (measured on
-        the dev tunnel: ~50 ms/launch, tools/diag_remnant.py r4).  A large
-        launch_cost collapses the decomposition to a single cover part —
-        the smallest menu size >= n — which never launches more often OR
-        schedules more pixels than padding to the full global batch.
+        return decompose(n, menu, area, launch_cost)
 
-        Deterministic; parts returned descending, so any fill slots land
-        in the final (smallest) part.
-
-        Bottom-up table over 0..n, not recursion: the memoized recursive
-        form went ~n/min(menu) frames deep, which blows Python's stack at
-        batch_quantum=1 once merged straggler counts span several large
-        global batches (ADVICE r4)."""
-        base = (0.0, 0, ())
-        best = [base] * (n + 1 if n > 0 else 1)
-        for r in range(1, n + 1):
-            # ties on cost prefer fewer launches, then the
-            # lexicographically smallest part tuple (determinism)
-            best[r] = min(
-                (area * s + launch_cost + sub[0], 1 + sub[1], (s,) + sub[2])
-                for s in menu
-                for sub in (best[r - s] if r > s else base,))
-        return tuple(sorted(best[n if n > 0 else 0][2], reverse=True))
+    def _cell_counts(self) -> Dict[Tuple[int, int], int]:
+        counts = getattr(self, "_cell_counts_cache", None)
+        if counts is None:
+            counts = self._cell_counts_cache = dict(collections.Counter(
+                self._bucket_key(self._item_shape(i))
+                for i in range(len(self.dataset))))
+        return counts
 
     def _partial_plan(self):
-        """Epoch-invariant remnant plan for ladder mode.
+        """Epoch-invariant launch plan for ladder+remnant mode.
 
         An item's bucket cell is a pure function of its shape, so each
-        cell's item count — hence each cell's partial-group size
-        (count mod gbs) — is identical in every epoch; only WHICH items
-        are left over varies with the shuffle.  The plan can therefore be
-        computed once from the shape histogram:
-
-        * each cell's remainder decomposes into menu sub-batch sizes
-          (near-zero fill) instead of padding to the full global batch —
-          the dead-slot waste the round-3 telemetry measured at ~11% of
-          step compute on the bench distribution;
-        * every distinct (bucket shape, batch size) pair is one XLA
-          program, so the plan merges the cheapest pair of partial groups
-          (at the elementwise-max join cell — still a ladder grid cell)
-          until the TOTAL program count — full-batch shapes plus remnant
-          parts — fits ``max_buckets``, and also whenever a merge strictly
-          reduces scheduled pixels (possible when quantum > 1 leaves fill).
-
-        Returns ``(plan, programs)`` where plan is
-        ``[(join_key, (source_keys...), (part_sizes...))]`` sorted by key
-        and programs is the set of (key, size) pairs the whole schedule
-        compiles.  Deterministic: counts come from the sorted dataset
-        listing and ties pick the first candidate pair in sorted order, so
-        every host computes the same plan.
+        cell's item count — hence its full/remnant split — is identical
+        in every epoch; only WHICH items fill the slots varies with the
+        shuffle.  The plan is therefore computed once from the shape
+        histogram by ``planner.GlobalPlanner`` (full-cell batch sizing
+        under the HBM cap, remnant menu composition, merge + local-search
+        packing, program-budget levers) and cached.  Returns a
+        ``planner.Plan``; ``legacy_fallback=True`` means the
+        pad-every-straggler-to-gbs path proved cheaper and
+        ``global_schedule`` falls through to it.
         """
         if self._plan_cache is not None:
             return self._plan_cache
-        gbs = self.batch_size * self.process_count
-        menu = self._remnant_menu()
-        lc = float(self.launch_cost_px)
-        counts = collections.Counter(
-            self._bucket_key(self._item_shape(i))
-            for i in range(len(self.dataset)))
-        full_programs = set()
-        groups = []
-        for k, c in sorted(counts.items()):
-            cg = self._cell_gbs(k, menu)  # pixel cap may shrink this cell's
-            if c >= cg:                   # full-batch size below gbs
-                full_programs.add((k, cg))
-            if c % cg:
-                groups.append((k, c % cg, (k,)))
-        groups.sort()
+        from can_tpu.data.planner import GlobalPlanner
 
-        def cost(key, count, m=None):
-            area = key[0] * key[1]
-            parts = self._decompose(count, self._menu_for(key, m or menu),
-                                    area, lc)
-            return area * sum(parts) + lc * len(parts)
+        def warn(msg):
+            tag = msg[:40]
+            if tag not in self._cap_warned:
+                self._cap_warned.add(tag)
+                print(f"[batching] WARNING: {msg}")
 
-        def total_cost(gs, m=None):
-            return sum(cost(k, c, m) for k, c, _ in gs)
-
-        def parts_of(k, c, m=None):
-            return self._decompose(c, self._menu_for(k, m or menu),
-                                   k[0] * k[1], lc)
-
-        def programs(gs, m=None):
-            ps = set(full_programs)
-            for k, c, _ in gs:
-                ps.update((k, s) for s in parts_of(k, c, m))
-            return ps
-
-        # Two levers shrink the program count when over budget, and the
-        # cheaper one (scheduled-pixel delta) is applied each round:
-        # * MERGE two partial groups at their elementwise-max join cell
-        #   (fewer groups, but small groups inherit a bigger shape);
-        # * DROP the smallest menu size (fewer sizes — remnants pad up to
-        #   the next size, a few fill slots, no shape inflation).
-        # Improvement merges (delta < 0, possible when quantum > 1 leaves
-        # fill) apply even within budget.
-        while True:
-            over = len(programs(groups)) > self.max_buckets
-            best = None  # (delta, kind, payload)
-            cap = self.max_launch_px
-            if len(groups) > 1:
-                for i in range(len(groups)):
-                    ki, ci, _ = groups[i]
-                    for j in range(i + 1, len(groups)):
-                        kj, cj, _ = groups[j]
-                        join = (max(ki[0], kj[0]), max(ki[1], kj[1]))
-                        # the no-OOM promise outranks the compile budget:
-                        # never create a join cell with NO cap-fitting
-                        # launch size — _menu_for's floor fallback would
-                        # launch it above the cap (code-review r5)
-                        if cap is not None and all(
-                                s * join[0] * join[1] > cap for s in menu):
-                            continue
-                        delta = (cost(join, ci + cj)
-                                 - cost(ki, ci) - cost(kj, cj))
-                        if (delta < 0 or over) and (
-                                best is None or delta < best[0]):
-                            best = (delta, "merge", (i, j, join))
-            # menu-drop lever: under a pixel cap, dropping the smallest
-            # size is only legal if every CURRENT cell — including joins
-            # created by earlier merges, whose keys are larger than any
-            # original bucket (code-review r5) — still has a fitting
-            # launch size afterwards (full-batch AND partial)
-            if over and len(menu) > 1:
-                shorter = menu[:-1]
-                safe = cap is None or all(
-                    any(s * g[0][0] * g[0][1] <= cap for s in shorter)
-                    for g in groups)
-                if safe:
-                    delta = total_cost(groups, shorter) - total_cost(groups)
-                    if best is None or delta < best[0]:
-                        best = (delta, "drop", shorter)
-            if best is None or (best[0] >= 0 and not over):
-                if over and "budget" not in self._cap_warned:
-                    # the pixel cap outranks the compile budget, so a
-                    # plan can now finish ABOVE max_buckets when every
-                    # remaining merge would create a cap-unfittable join
-                    # — say so instead of silently blowing the budget
-                    # (code-review r5)
-                    self._cap_warned.add("budget")
-                    print(f"[batching] WARNING: "
-                          f"{len(programs(groups))} programs exceed "
-                          f"max_buckets={self.max_buckets} — the "
-                          f"per-launch pixel cap prevents further "
-                          f"merging; expect extra XLA compiles")
-                break
-            if best[1] == "drop":
-                menu = best[2]
-                continue
-            _, _, (i, j, join) = best
-            merged = (join, groups[i][1] + groups[j][1],
-                      tuple(sorted(set(groups[i][2] + groups[j][2]))))
-            groups = sorted([g for t, g in enumerate(groups)
-                             if t not in (i, j)] + [merged])
-
-        # Safety net: never schedule more pixels than the legacy path
-        # (improvement-only merging + pad-every-straggler-to-gbs) would.
-        # The greedy above can land worse when full-batch shapes alone
-        # saturate the budget and forced merges inflate small groups.
-        # Skipped under a pixel cap: legacy pads every straggler to the
-        # FULL global batch, which is exactly what a capped cell must not
-        # launch.
-        if self.max_launch_px is None:
-            legacy = _merge_partial_groups(
-                [(k, [(k, True)] * c) for k, c, _ in
-                 sorted((k, c % gbs, None)
-                        for k, c in counts.items() if c % gbs)],
-                gbs)
-            legacy_cost = sum((k[0] * k[1] * gbs + lc) * (-(-len(g) // gbs))
-                              for k, g in legacy)
-            if legacy and legacy_cost < total_cost(groups):
-                progs = set(full_programs) | {(k, gbs) for k, _ in legacy}
-                self._plan_cache = (None, progs)
-                return self._plan_cache
-        plan = [(k, srcs, parts_of(k, c)) for k, c, srcs in groups]
-        self._plan_cache = (plan, programs(groups))
+        planner = GlobalPlanner(self._cost_model(),
+                                max_buckets=self.max_buckets,
+                                mode=self.plan_mode, warn=warn)
+        self._plan_cache = planner.plan_with_fallback(self._cell_counts())
         return self._plan_cache
 
     def program_count(self, epoch: int = 0) -> int:
@@ -677,6 +598,45 @@ class ShardedBatcher:
         sub-batches, shapes alone undercount)."""
         return len({(key, len(group))
                     for key, group in self.global_schedule(epoch)})
+
+    def planner_stats(self, epoch: int = 0) -> Dict[str, object]:
+        """One flat dict of planner decisions + realized schedule
+        economics for this epoch — the payload of the ``data.planner``
+        telemetry event (live gauges on the /metrics exporter) and the
+        plan-ablation bench tier.  Predicted numbers come from the cost
+        model; realized ones are re-derived from the emitted schedule, so
+        a divergence between the two is a planner bug, not noise (pinned
+        by test)."""
+        sched = self.global_schedule(epoch)
+        used_px = sum(k[0] * k[1] * len(g) for k, g in sched)
+        valid_px = sum(h * w for h, w in
+                       (self._item_shape(i) for i in range(len(self.dataset))))
+        stats = {
+            "plan_mode": self.plan_mode,
+            "padding_overhead": round(self.padding_overhead(), 4),
+            "schedule_overhead": round(used_px / max(valid_px, 1) - 1.0, 4),
+            "program_count": len({(k, len(g)) for k, g in sched}),
+            "batches_per_epoch": len(sched),
+            "realized_px": float(used_px),
+            "realized_cost_px": float(used_px
+                                      + self.launch_cost_px * len(sched)),
+            "launch_cost_px": float(self.launch_cost_px),
+            "max_launch_px": self.max_launch_px,
+            "max_buckets": self.max_buckets,
+        }
+        if self.bucket_ladder is not None and self.remnant_sizes:
+            plan = self._partial_plan()
+            stats.update(
+                plan_cost_px=float(plan.cost),
+                plan_scheduled_px=float(plan.scheduled_px),
+                plan_launches=plan.launches,
+                plan_programs=len(plan.programs),
+                lowered_cells=plan.lowered_cells,
+                lowered_launches=plan.lowered_launches,
+                legacy_fallback=plan.legacy_fallback,
+                menu_sizes=len(plan.menu),
+            )
+        return stats
 
     def global_schedule(self, epoch: int) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
         """Deterministic global batch plan: [(bucket_hw, [(idx, valid)] of
@@ -690,12 +650,52 @@ class ShardedBatcher:
         gbs = self.batch_size * self.process_count
         remnant_mode = self.remnant_sizes
         menu = self._remnant_menu() if remnant_mode else None
+
+        plan = None
+        if self.bucket_ladder is not None and remnant_mode:
+            # remnant sub-batches: the epoch-invariant plan (_partial_plan,
+            # a pure function of the shape histogram — identical on every
+            # host and in every epoch; the shuffle only decides which
+            # concrete items fill the slots) fixes each cell's full-launch
+            # sizes AND the straggler groups' join cells + part sizes.
+            # legacy_fallback means the planner proved the
+            # pad-every-straggler-to-gbs path cheaper — fall through.
+            plan = self._partial_plan()
+            if plan.legacy_fallback:
+                plan = None
+        if plan is not None:
+            # stream full launches as cells fill: each cell's planned part
+            # sizes are descending, so thresholds are hit in order
+            next_full = {k: list(parts)
+                         for k, parts in plan.full_parts.items()}
+            pending = {}
+            schedule = []
+            for idx in order.tolist():
+                key = self._bucket_key(self._item_shape(idx))
+                group = pending.setdefault(key, [])
+                group.append((idx, True))
+                parts = next_full.get(key)
+                if parts and len(group) == parts[0]:
+                    schedule.append((key, group))
+                    pending[key] = []
+                    parts.pop(0)
+            for pg in plan.groups:
+                items = [it for k in pg.sources for it in pending.get(k, [])]
+                pos = 0
+                for size in pg.parts:
+                    take = items[pos:pos + size]
+                    pos += size
+                    if len(take) < size:
+                        take = take + [(take[0][0], False)] * (size - len(take))
+                    schedule.append((pg.key, take))
+            return schedule
+
         full_size = {}  # per-cell full-batch size (pixel cap may shrink it)
 
         def cell_full(key):
             s = full_size.get(key)
             if s is None:
-                s = full_size[key] = (self._cell_gbs(key, menu)
+                s = full_size[key] = (max(self._menu_for(key, menu))
                                       if remnant_mode else gbs)
             return s
 
@@ -708,27 +708,6 @@ class ShardedBatcher:
             if len(group) == cell_full(key):
                 schedule.append((key, group))
                 pending[key] = []
-        if self.bucket_ladder is not None and self.remnant_sizes:
-            # remnant sub-batches: emit each (merged) straggler group as a
-            # short menu of smaller static batches (near-zero fill) instead
-            # of one full-gbs batch that is mostly dead slots.  The plan —
-            # which cells merge where, and the part sizes — is a pure
-            # function of the shape histogram (_partial_plan), so it is
-            # identical on every host and in every epoch; the shuffle only
-            # decides which concrete items fill the slots.  plan=None means
-            # the planner proved the legacy path cheaper — fall through.
-            plan, _ = self._partial_plan()
-            if plan is not None:
-                for join_key, sources, parts in plan:
-                    items = [it for k in sources for it in pending.get(k, [])]
-                    pos = 0
-                    for size in parts:
-                        take = items[pos:pos + size]
-                        pos += size
-                        if len(take) < size:
-                            take = take + [(take[0][0], False)] * (size - len(take))
-                        schedule.append((join_key, take))
-                return schedule
         if self.bucket_ladder is None and self.remnant_sizes:
             # exact / fixed-multiple modes: remnant sizes WITHOUT merging,
             # COVER-ONLY (a single part per straggler group: the smallest

@@ -46,11 +46,15 @@ import numpy as np
 # health.* kinds come from the run-health layer (can_tpu/obs/health.py):
 # live anomaly alerts (spike / plateau / nan_precursor / nan /
 # throughput_regression / stall_budget) and the per-epoch rollup.
+# data.planner carries the batch planner's per-epoch decisions and
+# schedule economics (padding/schedule overhead, program and lowered-
+# launch counts, predicted-vs-realized plan cost — ShardedBatcher.
+# planner_stats), exported as can_tpu_planner_* gauges by obs/exporter.py.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
-               "data.prepared", "data.cache",
+               "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary")
 
 

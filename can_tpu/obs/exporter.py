@@ -150,6 +150,16 @@ class GaugeSink:
                 self._count((f"{pre}_health_alerts_total",
                              (("signal", str(p.get("signal", "?"))),
                               ("kind", str(p.get("alert", "?"))))))
+            elif kind == "data.planner":
+                # batch-planner economics (ShardedBatcher.planner_stats):
+                # padding/schedule overhead, program + lowered-launch
+                # counts, plan cost — numeric payload entries become
+                # can_tpu_planner_* gauges (last epoch wins)
+                for k, v in p.items():
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool) and v is not None:
+                        self._gauges[f"{pre}_planner_{_sanitize(k)}"] = \
+                            float(v)
 
     def close(self) -> None:
         pass  # in-memory only; the exporter's lifecycle is the CLI's

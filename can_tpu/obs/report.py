@@ -71,6 +71,7 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_valid = 0
     serve_queue_depth_max = None
     cache_last: Optional[dict] = None
+    planner_last: Optional[dict] = None
     prepared_splits: dict = {}
     alerts: dict = {}
     health_last: Optional[dict] = None
@@ -132,6 +133,8 @@ def summarize(events: Iterable[dict]) -> dict:
             health_last = p  # per-epoch rollup: the last wins
         elif kind == "data.cache":
             cache_last = p  # counters are cumulative: the last wins
+        elif kind == "data.planner":
+            planner_last = p  # plan is epoch-invariant: the last wins
         elif kind == "data.prepared":
             split = str(p.get("split", "?"))
             prepared_splits[split] = ("on" if p.get("active")
@@ -176,6 +179,18 @@ def summarize(events: Iterable[dict]) -> dict:
                                  if cache_last else None),
         "cache_evictions": (cache_last.get("evictions")
                             if cache_last else None),
+        # batch planner (can_tpu/data/planner.py); Nones when not emitted
+        "planner_mode": planner_last.get("plan_mode") if planner_last else None,
+        "planner_padding_overhead": (planner_last.get("padding_overhead")
+                                     if planner_last else None),
+        "planner_schedule_overhead": (planner_last.get("schedule_overhead")
+                                      if planner_last else None),
+        "planner_programs": (planner_last.get("program_count")
+                             if planner_last else None),
+        "planner_lowered_launches": (planner_last.get("lowered_launches")
+                                     if planner_last else None),
+        "planner_realized_programs": (planner_last.get("realized_programs")
+                                      if planner_last else None),
         # run-health layer (can_tpu/obs/health.py); zeros/Nones when off
         "health_alerts": sum(alerts.values()),
         "health_alerts_by_kind": dict(sorted(alerts.items())),
@@ -229,6 +244,18 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
              f"{_fmt(summary['cache_bytes'])} / {_fmt(cap)}"
              f" (evictions={_fmt(summary['cache_evictions'])})"),
         ]
+    if summary.get("planner_schedule_overhead") is not None:
+        rows.append(
+            ("batch planner",
+             f"mode={summary['planner_mode']} "
+             f"padding={_fmt(summary['planner_padding_overhead'])} "
+             f"schedule={_fmt(summary['planner_schedule_overhead'])} "
+             f"programs={_fmt(summary['planner_programs'])}"
+             + (f" (realized {summary['planner_realized_programs']})"
+                if summary.get("planner_realized_programs") is not None
+                else "")
+             + (f" lowered={summary['planner_lowered_launches']}"
+                if summary.get("planner_lowered_launches") else "")))
     if summary.get("health_alerts"):
         by_kind = summary.get("health_alerts_by_kind") or {}
         rows.append(("health alerts",

@@ -33,15 +33,29 @@ from can_tpu.parallel.mesh import DATA_AXIS
 from can_tpu.train.steps import make_eval_step, make_train_step
 
 
+_SHARDING_CACHE: dict = {}
+
+
 def _batch_shardings(mesh: Mesh, spatial: bool = False) -> dict:
     from can_tpu.parallel.mesh import SPATIAL_AXIS
 
+    # keyed on (mesh, spatial): make_global_batch runs once per transferred
+    # batch, and with the cost planner's exact-size remnant menus an epoch
+    # launches more distinct (shape, size) batches than before — the four
+    # NamedSharding constructions per call are pure waste (Mesh hashes by
+    # device assignment, so a rebuilt-but-identical mesh still hits)
+    got = _SHARDING_CACHE.get((mesh, spatial))
+    if got is not None:
+        return got
     if spatial:
         s = NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS, None, None))
-        return {"image": s, "dmap": s, "pixel_mask": s,
-                "sample_mask": NamedSharding(mesh, P(DATA_AXIS))}
-    s = NamedSharding(mesh, P(DATA_AXIS))
-    return {"image": s, "dmap": s, "pixel_mask": s, "sample_mask": s}
+        out = {"image": s, "dmap": s, "pixel_mask": s,
+               "sample_mask": NamedSharding(mesh, P(DATA_AXIS))}
+    else:
+        s = NamedSharding(mesh, P(DATA_AXIS))
+        out = {"image": s, "dmap": s, "pixel_mask": s, "sample_mask": s}
+    _SHARDING_CACHE[(mesh, spatial)] = out
+    return out
 
 
 def make_global_batch(batch: Batch, mesh: Mesh, *, spatial: bool = False) -> dict:

@@ -44,10 +44,11 @@ class EpochStats(NamedTuple):
     """One epoch's results: mean per-image ``loss`` plus throughput.
 
     ``images`` counts valid samples (mask-zero fill slots excluded);
-    ``distinct_shapes`` is the batch shapes seen = executables exercised
-    this epoch.  (Until r4 this subclassed float so old callers could
-    treat the whole object as the loss — a surprise worth breaking: read
-    ``stats.loss`` explicitly, VERDICT r4 weak-5.)"""
+    ``distinct_shapes`` counts distinct full batch signatures (batch dim
+    included) seen this epoch = executables exercised.  (Until r4 this
+    subclassed float so old callers could treat the whole object as the
+    loss — a surprise worth breaking: read ``stats.loss`` explicitly,
+    VERDICT r4 weak-5.)"""
 
     loss: float
     seconds: float = 0.0
@@ -58,6 +59,17 @@ class EpochStats(NamedTuple):
     @property
     def img_per_s(self) -> float:
         return self.images / self.seconds if self.seconds > 0 else 0.0
+
+    @property
+    def programs(self) -> int:
+        """Realized program count: with remnant/lowered sub-batches a
+        bucket shape runs at several batch sizes, each its own XLA
+        program — the (B, H, W) signature count IS that number (the
+        batch dim rides the signature), counted here from the batches
+        the step actually saw so the planner's predicted
+        ``program_count`` can be checked against reality per epoch
+        (``data.planner`` telemetry)."""
+        return self.distinct_shapes
 
 
 def _arm_telemetry(telemetry, step_fn, *, name: str):

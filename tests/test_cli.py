@@ -221,9 +221,15 @@ class TestTrainCLI:
         events = [json.loads(l) for l in open(path)]  # every line parses
         kinds = {e["kind"] for e in events}
         assert {"compile", "step_window", "stall", "memory", "heartbeat",
-                "epoch"} <= kinds, kinds
+                "epoch", "data.planner"} <= kinds, kinds
         for e in events:
             assert set(e) == {"ts", "kind", "step", "host_id", "payload"}
+        # planner gauges ride the bus once per epoch, with the realized
+        # program count cross-checking the plan (r8)
+        pl = [e for e in events if e["kind"] == "data.planner"]
+        assert len(pl) == 2
+        assert pl[0]["payload"]["program_count"] >= 1
+        assert pl[0]["payload"]["realized_programs"] >= 1
         # epoch events carry the wandb-bound scalars (the MetricLogger
         # adapter forwards exactly these)
         ep = [e for e in events if e["kind"] == "epoch"]

@@ -860,12 +860,21 @@ class TestRemnantSubBatches:
         assert totals[0] == 64
 
     def test_parts_are_menu_sizes_and_quantum_multiples(self):
+        # cost mode (the default): every quantum multiple up to the
+        # global batch is a legal launch size — dp-divisibility is the
+        # only hard constraint, exact-size covers kill the fill slots the
+        # old power-of-two menu paid.  Legacy keeps gbs + quantum * 2^j.
         b = self._mk(_bench_like_shapes(), bs=8, batch_quantum=2)
         menu = set(b._remnant_menu())
-        assert menu == {8, 2, 4}
+        assert menu == {8, 6, 4, 2}
         for _, group in b.global_schedule(0):
             assert len(group) in menu
             assert len(group) % 2 == 0
+        legacy = self._mk(_bench_like_shapes(), bs=8, batch_quantum=2,
+                          plan_mode="legacy")
+        assert set(legacy._remnant_menu()) == {8, 4, 2}
+        for _, group in legacy.global_schedule(0):
+            assert len(group) in {8, 4, 2}
 
     def test_quantum_validation(self):
         with pytest.raises(ValueError, match="process_count"):
@@ -986,8 +995,12 @@ class TestRemnantSubBatches:
         big = max(k[0] * k[1] for k, _ in b.global_schedule(1))
         assert any(k[0] * k[1] == big and len(g) < 16
                    for k, g in b.global_schedule(1))
-        # uncapped plan would launch the biggest cell at the full batch
-        unc = self._mk(sizes, bs=16, launch_cost_px=2e6)
+        # the uncapped LEGACY plan launches the biggest cell at the full
+        # batch, proving the cap binds (the cost-mode planner's ladder
+        # search may avoid over-cap launches on its own — that is the
+        # point of the cost model, not a missing cap)
+        unc = self._mk(sizes, bs=16, launch_cost_px=2e6,
+                       plan_mode="legacy")
         assert any(k[0] * k[1] * len(g) > cap
                    for k, g in unc.global_schedule(1))
 

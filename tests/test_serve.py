@@ -264,6 +264,38 @@ class TestBatcherFlush:
         for hw in [(64, 96), (65, 96), (128, 160), (200, 300), (8, 8)]:
             assert b.bucket_of(hw) == snap_to_bucket(hw, ladder=ladder)
 
+    def test_cost_planner_ladder_shared_with_serving(self):
+        """Serving inherits the r8 cost-model planner's boundaries
+        without a fork: hand a cost-mode auto ladder to MicroBatcher and
+        every dataset shape maps to the EXACT cell the offline batcher
+        uses (snap_to_bucket is the single source of the mapping — the
+        r8 _resolve_auto_buckets changes moved boundary placement, not
+        the shape->cell function)."""
+        import numpy as np
+
+        from can_tpu.data import ShardedBatcher
+
+        rng = np.random.default_rng(5)
+        shapes = [(int(rng.integers(8, 40)) * 8, int(rng.integers(8, 40)) * 8)
+                  for _ in range(60)]
+
+        class ShapeOnly:
+            def __len__(self):
+                return len(shapes)
+
+            def snapped_shape(self, i):
+                return shapes[i]
+
+        off = ShardedBatcher(ShapeOnly(), 8, shuffle=True, seed=0,
+                             pad_multiple="auto", max_buckets=8,
+                             remnant_sizes=True, batch_quantum=1,
+                             launch_cost_px=0.05e6)
+        assert off.plan_mode == "cost" and off.bucket_ladder is not None
+        online = MicroBatcher(BoundedRequestQueue(4), lambda *a: None,
+                              bucket_ladder=off.bucket_ladder)
+        for hw in shapes + [(1, 1), (4096, 4096)]:
+            assert online.bucket_of(hw) == off._bucket_key(hw)
+
     def test_flush_all_drains_pending(self):
         d = CollectDispatch()
         q, b, clock = self.make(d, max_batch=8)

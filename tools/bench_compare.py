@@ -132,6 +132,12 @@ def main(argv=None) -> int:
                         "6-30%% on the 2-vCPU bench host)")
     p.add_argument("--json", action="store_true",
                    help="emit the comparison rows as JSON")
+    p.add_argument("--min-overlap", type=int, default=0,
+                   help="fail unless at least this many metrics were "
+                        "actually compared (ok/improved/regression): a "
+                        "gate whose runs share no metric names would "
+                        "otherwise pass vacuously (tools/ci_bench_gate.sh "
+                        "sets this)")
     args = p.parse_args(argv)
     rows = compare(load_suite(args.old), load_suite(args.new),
                    default_spread_pct=args.default_spread_pct)
@@ -139,6 +145,12 @@ def main(argv=None) -> int:
         print(json.dumps(rows))
     else:
         print(format_rows(rows))
+    compared = sum(r["verdict"] in ("ok", "improved", "regression")
+                   for r in rows)
+    if compared < args.min_overlap:
+        print(f"# FAIL: only {compared} comparable metric(s), "
+              f"need >= {args.min_overlap}")
+        return 1
     return 1 if any(r["verdict"] == "regression" for r in rows) else 0
 
 

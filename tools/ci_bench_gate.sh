@@ -1,0 +1,43 @@
+#!/usr/bin/env sh
+# CI bench gate: run a fresh host-pipeline suite and compare it against the
+# committed baseline with tools/bench_compare.py — exit 0 iff no metric
+# regressed beyond the recorded spread_pct noise floor (the suite's
+# round-robin repeats measure 6-30% host drift on the 2-vCPU bench box,
+# so the gate trips on real regressions, not load noise).
+#
+#   tools/ci_bench_gate.sh                    # vs BENCH_SUITE_r07.json
+#   tools/ci_bench_gate.sh MY_BASELINE.json
+#
+# Environment knobs:
+#   CI_BENCH_OUT           where the fresh run's records land
+#                          (default /tmp/ci_bench_suite.jsonl)
+#   CI_BENCH_ONLY          BENCH_SUITE_ONLY filter (default "host": the
+#                          host tier needs no accelerator and its r07
+#                          baseline entries carry measured spreads)
+#   CI_BENCH_SKIP_RUN=1    compare-only: gate an existing CI_BENCH_OUT
+#                          (also what the tier-1 test uses)
+#   CI_DEFAULT_SPREAD_PCT  noise floor for entries without a recorded
+#                          spread (default 10)
+#   CI_MIN_OVERLAP         minimum actually-compared metrics (default 3);
+#                          guards against a vacuous pass when the fresh
+#                          run emitted nothing comparable
+set -eu
+
+BASELINE=${1:-BENCH_SUITE_r07.json}
+OUT=${CI_BENCH_OUT:-/tmp/ci_bench_suite.jsonl}
+ONLY=${CI_BENCH_ONLY:-host}
+
+cd "$(dirname "$0")/.."
+
+if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
+    # two steps, not a pipe: POSIX sh has no pipefail, and `suite | grep`
+    # would let a mid-run bench crash ship a truncated-but-green artifact
+    RAW=${OUT}.raw
+    BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python bench_suite.py > "$RAW"
+    grep '^{' "$RAW" > "$OUT"
+fi
+
+exec python tools/bench_compare.py "$BASELINE" "$OUT" \
+    --default-spread-pct "${CI_DEFAULT_SPREAD_PCT:-10}" \
+    --min-overlap "${CI_MIN_OVERLAP:-3}"
