@@ -56,11 +56,20 @@ def _percentiles_ms(latencies_s: list) -> dict:
             "max_ms": round(float(arr.max()), 3)}
 
 
+def _queue_wait_p95_ms(queue_waits_s: list):
+    """p95 of the submit->assembly waits the span timestamps price — the
+    number that says whether tail latency is batching or the device."""
+    if not queue_waits_s:
+        return None
+    arr = np.asarray(queue_waits_s, np.float64) * 1e3
+    return round(float(np.percentile(arr, 95)), 3)
+
+
 def run_closed_loop(service, images, n_requests: int, n_clients: int) -> dict:
     """K clients, each submit->wait->repeat; returns latency/throughput."""
     from can_tpu.serve import RejectedError
 
-    latencies, rejects = [], [0]
+    latencies, queue_waits, rejects = [], [], [0]
     lock = threading.Lock()
     counter = [0]
 
@@ -76,6 +85,8 @@ def run_closed_loop(service, images, n_requests: int, n_clients: int) -> dict:
                                       timeout=120.0)
                 with lock:
                     latencies.append(res.latency_s)
+                    if res.queue_wait_s is not None:
+                        queue_waits.append(res.queue_wait_s)
             except RejectedError:
                 with lock:
                     rejects[0] += 1
@@ -92,7 +103,9 @@ def run_closed_loop(service, images, n_requests: int, n_clients: int) -> dict:
             "rejected": rejects[0],
             "reject_rate": round(rejects[0] / max(n_requests, 1), 4),
             "throughput_rps": round(done / wall, 2),
-            "wall_s": round(wall, 3), **_percentiles_ms(latencies)}
+            "wall_s": round(wall, 3),
+            "queue_wait_p95_ms": _queue_wait_p95_ms(queue_waits),
+            **_percentiles_ms(latencies)}
 
 
 def run_open_loop(service, images, n_requests: int, rate_rps: float,
@@ -113,10 +126,13 @@ def run_open_loop(service, images, n_requests: int, rate_rps: float,
             time.sleep(sleep)
         tickets.append(service.submit(images[i % len(images)],
                                       deadline_ms=deadline_ms))
-    latencies, rejects = [], 0
+    latencies, queue_waits, rejects = [], [], 0
     for t in tickets:
         try:
-            latencies.append(t.result().latency_s)
+            res = t.result()
+            latencies.append(res.latency_s)
+            if res.queue_wait_s is not None:
+                queue_waits.append(res.queue_wait_s)
         except RejectedError:
             rejects += 1
     wall = time.perf_counter() - t0
@@ -125,7 +141,9 @@ def run_open_loop(service, images, n_requests: int, rate_rps: float,
             "reject_rate": round(rejects / max(n_requests, 1), 4),
             "offered_rps": round(rate_rps, 2),
             "throughput_rps": round(len(latencies) / wall, 2),
-            "wall_s": round(wall, 3), **_percentiles_ms(latencies)}
+            "wall_s": round(wall, 3),
+            "queue_wait_p95_ms": _queue_wait_p95_ms(queue_waits),
+            **_percentiles_ms(latencies)}
 
 
 def main() -> None:

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 from typing import Dict, Optional, Tuple
 
@@ -193,6 +194,104 @@ _HBM_BY_DEVICE_KIND = (
 )
 
 
+# Peak compute / HBM bandwidth per JAX device by hardware generation —
+# spec constants for the perf-attribution layer (obs/costs.py), matched
+# exactly like _HBM_BY_DEVICE_KIND above (substring, first entry wins,
+# lite variants before their generation's bare row).  Units: FLOP/s at the
+# bf16 MXU rate, and HBM bytes/s.  v2/v3 rows are PER CORE (= per JAX
+# device); v4+ are per chip.  The f32 peak is modelled as bf16/2 — the
+# MXU takes bf16 inputs with f32 accumulation, and f32-input matmuls run
+# at roughly half rate; an approximation, but MFU consumers only need a
+# stable denominator, not a guarantee (the roofline CLASS depends only on
+# the ridge ratio, which the /2 preserves).
+_PEAK_BY_DEVICE_KIND = (
+    ("v5lite", (197e12, 819e9)),   # v5e
+    ("v5e", (197e12, 819e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v5", (459e12, 2765e9)),      # bare "TPU v5" = v5p (see HBM table)
+    ("v6lite", (918e12, 1640e9)),  # Trillium
+    ("v6e", (918e12, 1640e9)),
+    ("v4i", (138e12, 614e9)),
+    ("v4lite", (138e12, 614e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v3", (61.5e12, 450e9)),      # per core (123 TFLOP/s / 900 GB/s chip)
+    ("v2", (22.5e12, 300e9)),
+)
+
+# CPU pseudo-peaks: NOMINAL placeholders (≈ a laptop core's order of
+# magnitude), flagged nominal=True so every consumer can say "relative
+# only".  They exist so the MFU/roofline plumbing is exercisable (and
+# tier-1 testable) on the CPU backend — unlike the HBM table, nothing
+# here feeds scheduling, so a labelled fiction is acceptable where an
+# unlabelled one would not be.
+_CPU_NOMINAL_PEAKS = (5e10, 1e10)
+
+
+@dataclasses.dataclass(frozen=True)
+class DevicePeaks:
+    """Peak rates for one device kind (the roofline's two ceilings)."""
+
+    flops_bf16: float    # FLOP/s at the bf16 MXU rate
+    flops_f32: float     # approximated as bf16/2 (see table note)
+    hbm_bytes_s: float   # HBM bandwidth, bytes/s
+    source: str          # "spec:<kind>" or "nominal:cpu"
+    nominal: bool = False
+
+    def flops(self, compute: str = "f32") -> float:
+        return self.flops_bf16 if compute == "bf16" else self.flops_f32
+
+    def ridge(self, compute: str = "f32") -> float:
+        """Arithmetic intensity (FLOP/byte) where the roofline bends."""
+        return self.flops(compute) / self.hbm_bytes_s
+
+
+def _match_device_table(kind: str, table):
+    """Substring-match a ``device_kind`` against an ordered spec table:
+    case-insensitive, spaces stripped, first entry wins (lite variants
+    are listed before their generation's bare row).  Single-sourced so
+    ``_HBM_BY_DEVICE_KIND`` and ``_PEAK_BY_DEVICE_KIND`` can never
+    diverge in matching rules — returns ``(matched_key, value)`` or
+    ``(None, None)``."""
+    k = kind.lower().replace(" ", "")
+    for sub, val in table:
+        if sub in k:
+            return sub, val
+    return None, None
+
+
+def device_peaks_for_kind(kind: str) -> Optional[DevicePeaks]:
+    """Spec peaks for a TPU ``device_kind`` string, or None when the
+    generation isn't recognised (same matching rules as
+    ``hbm_bytes_for_device_kind``)."""
+    sub, val = _match_device_table(kind, _PEAK_BY_DEVICE_KIND)
+    if sub is None:
+        return None
+    flops, bw = val
+    return DevicePeaks(flops_bf16=float(flops),
+                       flops_f32=float(flops) / 2.0,
+                       hbm_bytes_s=float(bw), source=f"spec:{sub}")
+
+
+def local_device_peaks() -> Optional[DevicePeaks]:
+    """Peaks for THIS host's first local device: the spec table on TPU,
+    the labelled-nominal CPU entry on the CPU backend (so MFU gauges stay
+    exercisable in tests), None anywhere else."""
+    try:
+        dev = jax.local_devices()[0]
+    except Exception:
+        return None
+    try:
+        if dev.platform == "tpu":
+            return device_peaks_for_kind(dev.device_kind)
+        if dev.platform == "cpu":
+            f, bw = _CPU_NOMINAL_PEAKS
+            return DevicePeaks(flops_bf16=f, flops_f32=f, hbm_bytes_s=bw,
+                               source="nominal:cpu", nominal=True)
+    except Exception:
+        pass
+    return None
+
+
 def hbm_bytes_for_device_kind(kind: str) -> Optional[int]:
     """USABLE HBM bytes for a TPU ``device_kind`` string (spec total
     derated by the typical PJRT reservation, ``_PJRT_SPEC_DERATE`` — a
@@ -201,11 +300,10 @@ def hbm_bytes_for_device_kind(kind: str) -> Optional[int]:
     spaces stripped, first entry wins ("TPU v5 lite" and "TPU
     v5litepod-8" both hit "v5lite"; bare "TPU v5" falls through to the
     v5p row)."""
-    k = kind.lower().replace(" ", "")
-    for sub, size in _HBM_BY_DEVICE_KIND:
-        if sub in k:
-            return int(size * _PJRT_SPEC_DERATE)
-    return None
+    sub, size = _match_device_table(kind, _HBM_BY_DEVICE_KIND)
+    if sub is None:
+        return None
+    return int(size * _PJRT_SPEC_DERATE)
 
 
 def device_memory_bytes() -> Optional[int]:
@@ -481,6 +579,12 @@ def make_bucketed_train_step(apply_fn, optimizer, mesh, *, compute_dtype,
         shape = batch["image"].shape
         return steps[policy(tuple(shape[1:3]), batch=shape[0])](state, batch)
 
+    # cost-ledger seam (obs/costs.py): the jitted step this batch would
+    # dispatch to, so a ProgramCostLedger can AOT-read cost_analysis()
+    # through the remat dispatch closure
+    train_step.jit_for = lambda state, batch: steps[
+        policy(tuple(batch["image"].shape[1:3]),
+               batch=batch["image"].shape[0])]
     return train_step
 
 
@@ -527,4 +631,7 @@ def make_cached_sp_eval_step(mesh, *, compute_dtype=None):
         hw = (batch["image"].shape[1], batch["image"].shape[2])
         return cache(hw)(params, batch, batch_stats)
 
+    # cost-ledger seam, as in make_bucketed_train_step
+    eval_step.jit_for = lambda params, batch, batch_stats=None: cache(
+        (batch["image"].shape[1], batch["image"].shape[2]))
     return eval_step

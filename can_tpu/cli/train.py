@@ -293,6 +293,15 @@ def build_telemetry(args, *, host_id: int, trace_window, logger=None):
                                       extra_sinks=extra, trace=trace)
     else:
         tel = obs.Telemetry(extra, host_id=host_id, trace=trace)
+    # performance-attribution collaborators ride the same arming rule as
+    # the loop instrumentation: any consumer (JSONL artifact, live
+    # /metrics scraper, trace window) arms the cost ledger + span tracer;
+    # a default run constructs neither, so nothing new can touch its hot
+    # path.  The ledger prices MFU against the run's COMPUTE dtype.
+    if args.telemetry_dir or exporter is not None or trace_window:
+        tel.ledger = obs.ProgramCostLedger(
+            compute="bf16" if getattr(args, "bf16", False) else "f32")
+        tel.spans = obs.SpanTracer(tel)
     tel.emit("run", config={k: v for k, v in vars(args).items()
                             if isinstance(v, (str, int, float, bool,
                                               type(None)))})
@@ -563,6 +572,10 @@ def main(argv=None) -> int:
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)
 
+        # cost-ledger seam: the underlying jitted step for these args, so
+        # cost_analysis() can be read through the dispatch closure
+        train_step.jit_for = lambda state, batch: cache(
+            tuple(batch["image"].shape[1:3]))
         eval_step = make_cached_sp_eval_step(mesh, compute_dtype=compute_dtype)
     else:
         from can_tpu.cli.common import make_bucketed_train_step
@@ -588,6 +601,11 @@ def main(argv=None) -> int:
     telemetry, heartbeat, exporter = build_telemetry(
         args, host_id=process_index(), trace_window=trace_window,
         logger=logger)
+    if telemetry.ledger is not None:
+        # the drift gauge's denominator: the launch cost THIS run's plans
+        # were priced at, so launch_cost_drift reads as "measured fixed
+        # launch cost / what the planner assumed"
+        telemetry.ledger.plan_launch_cost_px = common["launch_cost_px"]
     # prepared-store status: one data.prepared event per split (the
     # one-line fallback record the store contract requires), echoed on
     # stdout for the main process

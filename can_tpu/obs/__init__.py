@@ -27,6 +27,7 @@ from .bus import (
     Telemetry,
     open_host_telemetry,
 )
+from .costs import ProgramCostLedger
 from .exporter import GaugeSink, MetricsExporter, render_stats
 from .health import (
     EwmaMadDetector,
@@ -42,6 +43,7 @@ from .sources import (
     device_memory_snapshot,
     emit_memory,
 )
+from .spans import SpanTracer
 from .trace import StepTraceWindow, parse_trace_steps
 
 __all__ = [
@@ -54,7 +56,9 @@ __all__ = [
     "MetricLoggerSink",
     "MetricsExporter",
     "PlateauDetector",
+    "ProgramCostLedger",
     "RecompileTracker",
+    "SpanTracer",
     "StallClock",
     "StdoutSink",
     "StepTraceWindow",

@@ -50,12 +50,21 @@ import numpy as np
 # schedule economics (padding/schedule overhead, program and lowered-
 # launch counts, predicted-vs-realized plan cost — ShardedBatcher.
 # planner_stats), exported as can_tpu_planner_* gauges by obs/exporter.py.
+# perf.summary and trace.span come from the performance-attribution layer:
+# perf.summary is the ProgramCostLedger's aggregate (per-program MFU /
+# roofline class / empirical launch cost, obs/costs.py — numeric keys
+# become can_tpu_mfu_* etc. gauges) and trace.span is one completed span
+# of a request/step trace tree (obs/spans.py; exported to Chrome
+# trace-event JSON by tools/trace_export.py).
+# tests/test_perf.py pins this tuple against the emit literals in the
+# tree — add the kind HERE when adding an emitter, or that test fails.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
                "data.prepared", "data.cache", "data.planner",
-               "health.alert", "health.summary")
+               "health.alert", "health.summary",
+               "perf.summary", "trace.span")
 
 
 def _jsonable(v):
@@ -148,6 +157,11 @@ class Telemetry:
         # RecompileTracker keeps per-wrapped-step-name signature sets here
         # so re-wrapping each epoch doesn't re-attribute old signatures
         self.signature_registry: dict = {}
+        # performance-attribution collaborators (armed by the CLIs when a
+        # consumer exists; None keeps every producer's guard dead cheap):
+        # ledger = obs.costs.ProgramCostLedger, spans = obs.spans.SpanTracer
+        self.ledger = None
+        self.spans = None
 
     @property
     def step(self) -> int:

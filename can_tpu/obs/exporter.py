@@ -160,6 +160,18 @@ class GaugeSink:
                             and not isinstance(v, bool) and v is not None:
                         self._gauges[f"{pre}_planner_{_sanitize(k)}"] = \
                             float(v)
+            elif kind == "perf.summary":
+                # performance-attribution aggregates (obs/costs.py
+                # ProgramCostLedger.summary): the payload keys are already
+                # gauge-shaped (mfu_weighted, roofline_*_bound,
+                # launch_cost_mpx_empirical, launch_cost_drift, ...), so
+                # numeric entries map verbatim to can_tpu_<key>; the
+                # per-program "detail" list and string provenance are for
+                # the JSONL/report, not the scrape
+                for k, v in p.items():
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        self._gauges[f"{pre}_{_sanitize(k)}"] = float(v)
 
     def close(self) -> None:
         pass  # in-memory only; the exporter's lifecycle is the CLI's

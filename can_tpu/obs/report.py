@@ -65,11 +65,15 @@ def summarize(events: Iterable[dict]) -> dict:
     last_heartbeat_ts = None
     epochs = set()
     serve_lat: List[float] = []
+    serve_queue_wait: List[float] = []
+    serve_device: List[float] = []
     serve_rejects: dict = {}
     serve_batches = 0
     serve_slots = 0
     serve_valid = 0
     serve_queue_depth_max = None
+    perf_last: Optional[dict] = None
+    span_names: dict = {}
     cache_last: Optional[dict] = None
     planner_last: Optional[dict] = None
     prepared_splits: dict = {}
@@ -112,6 +116,10 @@ def summarize(events: Iterable[dict]) -> dict:
         elif kind == "serve.request":
             if "latency_s" in p:
                 serve_lat.append(float(p["latency_s"]))
+            if "queue_wait_s" in p:
+                serve_queue_wait.append(float(p["queue_wait_s"]))
+            if "device_s" in p:
+                serve_device.append(float(p["device_s"]))
         elif kind == "serve.batch":
             serve_batches += 1
             serve_slots += int(p.get("size", 0))
@@ -139,6 +147,11 @@ def summarize(events: Iterable[dict]) -> dict:
             split = str(p.get("split", "?"))
             prepared_splits[split] = ("on" if p.get("active")
                                       else f"legacy({p.get('reason', '?')})")
+        elif kind == "perf.summary":
+            perf_last = p  # the ledger is cumulative: the last wins
+        elif kind == "trace.span":
+            name = str(p.get("name", "?"))
+            span_names[name] = span_names.get(name, 0) + 1
     wall_s = (last_ts - first_ts) if first_ts is not None else None
     return {
         "events": len(events),
@@ -169,6 +182,10 @@ def summarize(events: Iterable[dict]) -> dict:
         "serve_rejects": sum(serve_rejects.values()),
         "serve_rejects_by_reason": dict(sorted(serve_rejects.items())),
         "serve_queue_depth_max": serve_queue_depth_max,
+        # per-request breakdown (from the span timestamps; Nones pre-r9)
+        "serve_queue_wait_p50_s": _percentile(serve_queue_wait, 50),
+        "serve_queue_wait_p95_s": _percentile(serve_queue_wait, 95),
+        "serve_device_p95_s": _percentile(serve_device, 95),
         # host data pipeline (can_tpu/data/prepared.py); Nones/empty offline
         "prepared_splits": dict(sorted(prepared_splits.items())),
         "cache_hits": cache_last.get("hits") if cache_last else None,
@@ -196,6 +213,27 @@ def summarize(events: Iterable[dict]) -> dict:
         "health_alerts_by_kind": dict(sorted(alerts.items())),
         "health_suppressed": (health_last.get("suppressed")
                               if health_last else None),
+        # performance attribution (can_tpu/obs/costs.py + spans.py);
+        # Nones/zeros when the ledger/tracer were never armed
+        "perf_programs": perf_last.get("perf_programs") if perf_last else None,
+        "perf_mfu_weighted": (perf_last.get("mfu_weighted")
+                              if perf_last else None),
+        "perf_mfu_best": perf_last.get("mfu_best") if perf_last else None,
+        "perf_mfu_worst": perf_last.get("mfu_worst") if perf_last else None,
+        "perf_roofline_compute": (perf_last.get("roofline_compute_bound")
+                                  if perf_last else None),
+        "perf_roofline_memory": (perf_last.get("roofline_memory_bound")
+                                 if perf_last else None),
+        "perf_roofline_unknown": (perf_last.get("roofline_unknown")
+                                  if perf_last else None),
+        "perf_launch_cost_mpx": (perf_last.get("launch_cost_mpx_empirical")
+                                 if perf_last else None),
+        "perf_launch_cost_drift": (perf_last.get("launch_cost_drift")
+                                   if perf_last else None),
+        "perf_peak_nominal": (bool(perf_last.get("peak_nominal"))
+                              if perf_last else None),
+        "trace_spans": by_kind.get("trace.span", 0),
+        "trace_spans_by_name": dict(sorted(span_names.items())),
     }
 
 
@@ -256,6 +294,31 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                 else "")
              + (f" lowered={summary['planner_lowered_launches']}"
                 if summary.get("planner_lowered_launches") else "")))
+    if summary.get("perf_programs"):
+        nominal = " (NOMINAL peak)" if summary.get("perf_peak_nominal") else ""
+        rows.append(
+            ("perf MFU",
+             f"weighted={_fmt(summary['perf_mfu_weighted'])} "
+             f"best={_fmt(summary['perf_mfu_best'])} "
+             f"worst={_fmt(summary['perf_mfu_worst'])} "
+             f"programs={summary['perf_programs']}{nominal}"))
+        rows.append(
+            ("perf roofline",
+             f"compute={_fmt(summary['perf_roofline_compute'])} "
+             f"memory={_fmt(summary['perf_roofline_memory'])} "
+             f"unknown={_fmt(summary['perf_roofline_unknown'])}"))
+        if summary.get("perf_launch_cost_mpx") is not None:
+            rows.append(
+                ("perf launch cost",
+                 f"empirical={_fmt(summary['perf_launch_cost_mpx'])} Mpx"
+                 + (f" drift={_fmt(summary['perf_launch_cost_drift'])}x"
+                    if summary.get("perf_launch_cost_drift") is not None
+                    else "")))
+    if summary.get("trace_spans"):
+        names = summary.get("trace_spans_by_name") or {}
+        rows.append(("trace spans",
+                     f"{summary['trace_spans']} ("
+                     + " ".join(f"{k}={n}" for k, n in names.items()) + ")"))
     if summary.get("health_alerts"):
         by_kind = summary.get("health_alerts_by_kind") or {}
         rows.append(("health alerts",
@@ -276,6 +339,11 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                                        for k, n in rejects.items()) or "0"),
             ("serve queue max", _fmt(summary["serve_queue_depth_max"])),
         ]
+        if summary.get("serve_queue_wait_p95_s") is not None:
+            rows.append(
+                ("serve breakdown",
+                 f"queue_wait p95={_fmt(summary['serve_queue_wait_p95_s'])} s"
+                 f" device p95={_fmt(summary['serve_device_p95_s'])} s"))
     width = max(len(k) for k, _ in rows)
     lines = [f"# {title}"]
     lines += [f"{k.ljust(width)}  {v}" for k, v in rows]

@@ -68,9 +68,19 @@ class RecompileTracker:
         out = self._fn(*args)
         dt = time.perf_counter() - t0
         self._seen[sig] = dt
-        self._tel.emit("compile", name=self._name,
-                       signature=[list(s) for s in sig], seconds=round(dt, 4),
-                       n_signatures=len(self._seen))
+        payload = dict(name=self._name, signature=[list(s) for s in sig],
+                       seconds=round(dt, 4), n_signatures=len(self._seen))
+        # perf-attribution hook: with a ProgramCostLedger on the bus
+        # (Telemetry.ledger, armed by the CLIs), the new signature's XLA
+        # cost_analysis() flops/bytes are read at compile time and ride
+        # this same compile event; backends that report nothing degrade
+        # to the bare payload (the ledger never raises into the step)
+        ledger = getattr(self._tel, "ledger", None)
+        if ledger is not None:
+            cost = ledger.register(self._name, sig, fn=self._fn, args=args)
+            if cost is not None:
+                payload.update(cost)
+        self._tel.emit("compile", **payload)
         return out
 
 

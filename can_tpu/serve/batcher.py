@@ -173,6 +173,10 @@ class MicroBatcher:
     def _flush(self, key: GroupKey, group: List[ServeRequest]) -> None:
         bh, bw = key[0], key[1]
         try:
+            # assembly window stamped on every request (service clock):
+            # queue-wait ends where assembly starts, and the service turns
+            # the pair into the serve.request breakdown + request spans
+            t_asm = self._clock()
             # zero per-item density targets: serve batches reuse the
             # offline Batch layout (image/dmap/pixel_mask/sample_mask) so
             # the engine can run the exact eval-step math; dmap is unused
@@ -183,6 +187,10 @@ class MicroBatcher:
                      for r in group]
             batch = pad_batch(items, (bh, bw), self.max_batch,
                               [True] * len(group), self.ds)
+            t_ready = self._clock()
+            for r in group:
+                r.t_assembly = t_asm
+                r.t_ready = t_ready
             self.dispatch((bh, bw), batch, group)
         except Exception as e:  # noqa: BLE001 — poison batch, keep serving
             n = 0

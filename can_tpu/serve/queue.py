@@ -61,6 +61,11 @@ class ServeResult:
     bucket_hw: Tuple[int, int]           # static shape the batch ran at
     batch_fill: float                    # valid / total slots of its batch
     latency_s: float                     # submit -> resolve wall time
+    # latency breakdown (from the span timestamps; the bench's
+    # queue_wait_p95 and the HTTP trace_id ride these)
+    queue_wait_s: Optional[float] = None  # submit -> batch assembly start
+    device_s: Optional[float] = None      # engine execute wall time
+    trace_id: Optional[str] = None        # the request's span-tree id
 
 
 class ServeRequest:
@@ -88,6 +93,12 @@ class ServeRequest:
         # set by the queue at admission: fires exactly once when the
         # request resolves/rejects, so the queue can track outstanding load
         self._on_done = None
+        # span plumbing (all in the request's own clock): trace_id is
+        # minted by CountService.submit; the batcher stamps the assembly
+        # window so the service can price queue-wait vs device time
+        self.trace_id: Optional[str] = None
+        self.t_assembly: Optional[float] = None  # batch assembly began
+        self.t_ready: Optional[float] = None     # padded batch handed off
 
     def expired(self, now: float) -> bool:
         return self.deadline_ts is not None and now >= self.deadline_ts

@@ -117,7 +117,8 @@ class CountService:
                  default_deadline_ms: Optional[float] = None,
                  bucket_ladder=None, pad_multiple=None,
                  min_bucket_h: Optional[int] = None,
-                 telemetry=None, clock=time.monotonic):
+                 telemetry=None, clock=time.monotonic,
+                 perf_summary_every: int = 32):
         self.engine = engine
         self.telemetry = telemetry if telemetry is not None else engine.telemetry
         self.max_batch = int(max_batch)
@@ -153,6 +154,19 @@ class CountService:
         # unwarmed dtype would compile for seconds ON the batcher thread,
         # stalling every bucket's flushes mid-traffic
         self.warmed_dtypes: set = set()
+        # perf-attribution cadence: with a cost ledger on the bus
+        # (Telemetry.ledger), one perf.summary event per this many
+        # batches keeps the can_tpu_mfu_* gauges live without one event
+        # per request (0/negative disables the periodic emit; warmup and
+        # close still emit one each)
+        self.perf_summary_every = int(perf_summary_every)
+        self._perf_batches = 0
+        import os as _os
+
+        # pid + random tag: pid alone collides across containerised
+        # replicas (both typically pid 1), which would merge two
+        # unrelated requests' span trees in a joined artifact
+        self._trace_prefix = f"req-{_os.getpid():x}{_os.urandom(2).hex()}"
 
     # -- lifecycle -------------------------------------------------------
     def warmup(self, bucket_shapes: Sequence[Tuple[int, int]],
@@ -160,6 +174,12 @@ class CountService:
         report = self.engine.warmup(bucket_shapes, self.max_batch,
                                     dtypes=dtypes)
         self.warmed_dtypes.update(np.dtype(dt) for dt in dtypes)
+        ledger = getattr(self.telemetry, "ledger", None)
+        if ledger is not None:
+            # every warmed bucket's flops/bytes (hence roofline class) is
+            # known the moment warmup returns — publish before traffic;
+            # MFU joins in once real batches provide timings
+            ledger.emit_summary(self.telemetry, phase="serve_warmup")
         return report
 
     def start(self) -> "CountService":
@@ -177,6 +197,9 @@ class CountService:
             r.reject(REJECT_SHUTDOWN, "service closing")
             self._count_reject(REJECT_SHUTDOWN)
         self.batcher.close()  # flushes pending groups through the engine
+        ledger = getattr(self.telemetry, "ledger", None)
+        if ledger is not None:
+            ledger.emit_summary(self.telemetry, phase="serve_close")
         self._started = False
 
     def __enter__(self) -> "CountService":
@@ -197,6 +220,10 @@ class CountService:
                       else self.default_deadline_s)
         req = ServeRequest(np.asarray(image), deadline_s=deadline_s,
                            want_density=want_density, clock=self._clock)
+        # the trace is born at the front door: every span of this
+        # request's life (queue wait -> assembly -> device -> respond)
+        # keys on this id, and HTTP clients get it back in the response
+        req.trace_id = f"{self._trace_prefix}-{req.id}"
         if req.shape[0] % self.engine.ds or req.shape[1] % self.engine.ds:
             raise ValueError(
                 f"image shape {req.shape} is not snapped to the /"
@@ -247,26 +274,70 @@ class CountService:
 
     # -- batcher dispatch (runs on the batcher thread) -------------------
     def _dispatch(self, bucket_hw, batch, requests) -> None:
+        t_exec0 = self._clock()
         t0 = time.perf_counter()
         counts, density = self.engine.predict_batch(
             batch, want_density=any(r.want_density for r in requests))
+        # execute_s stays on perf_counter (honest wall time even under
+        # the fake clocks the tests drive); the CLOCK stamps below anchor
+        # the spans in the same timeline as t_submit/deadlines
         execute_s = time.perf_counter() - t0
+        t_exec1 = self._clock()
+        compiled = self.engine.last_batch_compiled
         fill = len(requests) / batch.image.shape[0]
         now = self._clock()
+        spans = getattr(self.telemetry, "spans", None)
+        # per-slot respond spans tile [t_exec1, ...] back to back: each
+        # slot's span starts where the previous slot finished, so a late
+        # slot's respond shows ITS OWN density fetch/resolve cost, not
+        # the sum of every sibling processed before it in this loop
+        t_resp0 = t_exec1
         for slot, req in enumerate(requests):
             h, w = req.shape
             dens = (np.asarray(density[slot, : h // self.engine.ds,
                                        : w // self.engine.ds])
                     if req.want_density else None)
             latency = now - req.t_submit
+            # assembly stamps come from the batcher; a request dispatched
+            # through a path that skipped them (flush_all on a hand-driven
+            # batcher) degrades to a zero-width assembly window
+            t_asm = req.t_assembly if req.t_assembly is not None else t_exec0
+            t_ready = req.t_ready if req.t_ready is not None else t_exec0
+            queue_wait = max(t_asm - req.t_submit, 0.0)
             req.resolve(ServeResult(count=float(counts[slot]), density=dens,
                                     bucket_hw=tuple(bucket_hw),
-                                    batch_fill=fill, latency_s=latency))
+                                    batch_fill=fill, latency_s=latency,
+                                    queue_wait_s=round(queue_wait, 6),
+                                    device_s=round(execute_s, 6),
+                                    trace_id=req.trace_id))
             with self._lock:
                 self.latency.record(latency, shape=tuple(bucket_hw))
             self.telemetry.emit("serve.request", request_id=req.id,
                                latency_s=round(latency, 6),
-                               bucket=list(bucket_hw), ok=True)
+                               bucket=list(bucket_hw), ok=True,
+                               trace_id=req.trace_id,
+                               queue_wait_s=round(queue_wait, 6),
+                               assembly_s=round(max(t_ready - t_asm, 0.0), 6),
+                               device_s=round(execute_s, 6))
+            if spans is not None:
+                # the submit->respond tree the Chrome export renders: one
+                # request-root with the four phases as children (device
+                # start anchored on the service clock, width = the real
+                # execute wall time)
+                t_done = self._clock()
+                root = spans.emit(trace_id=req.trace_id, name="request",
+                                  start=req.t_submit, end=t_done,
+                                  bucket=list(bucket_hw), ok=True)
+                spans.emit(trace_id=req.trace_id, name="queue_wait",
+                           start=req.t_submit, end=t_asm, parent_id=root)
+                spans.emit(trace_id=req.trace_id, name="batch_assembly",
+                           start=t_asm, end=t_ready, parent_id=root)
+                spans.emit(trace_id=req.trace_id, name="device",
+                           start=t_exec0, end=t_exec0 + execute_s,
+                           parent_id=root, compiled=compiled)
+                spans.emit(trace_id=req.trace_id, name="respond",
+                           start=t_resp0, end=t_done, parent_id=root)
+                t_resp0 = t_done
         with self._lock:
             self._stats["completed"] += len(requests)
             self._stats["batches"] += 1
@@ -276,8 +347,20 @@ class CountService:
                            size=batch.image.shape[0], valid=len(requests),
                            fill=round(fill, 4),
                            execute_s=round(execute_s, 6),
-                           compiled=self.engine.last_batch_compiled,
+                           compiled=compiled,
                            queue_depth=self.queue.depth())
+        ledger = getattr(self.telemetry, "ledger", None)
+        if ledger is not None:
+            if not compiled:
+                # steady-state launch time for this program (first-call
+                # compiles are the compile event's bill, same exclusion
+                # rule as the step reservoirs)
+                ledger.observe("serve_predict", tuple(batch.image.shape),
+                               execute_s, dtype=str(batch.image.dtype))
+            self._perf_batches += 1
+            if 0 < self.perf_summary_every <= self._perf_batches:
+                self._perf_batches = 0
+                ledger.emit_summary(self.telemetry, phase="serve")
 
     def _note_reject(self, reason: str, count: int = 1) -> None:
         """Count a rejection that already emitted its own telemetry
@@ -384,6 +467,12 @@ def make_http_handler(service: CountService):
                        "latency_ms": round(res.latency_s * 1e3, 3),
                        "bucket": list(res.bucket_hw),
                        "batch_fill": res.batch_fill}
+            if res.trace_id is not None:
+                # the handle into the exported span timeline
+                # (tools/trace_export.py --trace-id)
+                payload["trace_id"] = res.trace_id
+            if res.queue_wait_s is not None:
+                payload["queue_wait_ms"] = round(res.queue_wait_s * 1e3, 3)
             if res.density is not None:
                 payload["density"] = res.density[..., 0].tolist()
             self._send(200, payload)

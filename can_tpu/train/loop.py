@@ -93,7 +93,10 @@ def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
                           health=None) -> None:
     """Epoch-boundary events: stall accounting + device-memory snapshot +
     the step-time reservoir summary (per-shape breakdown included).
-    ``health`` escalates over-budget starvation into a ``health.alert``."""
+    ``health`` escalates over-budget starvation into a ``health.alert``.
+    With a cost ledger on the bus, the epoch's per-shape wall totals are
+    folded in and one ``perf.summary`` (per-program MFU / roofline /
+    launch-cost fit) closes the epoch — the /metrics gauges' feed."""
     from can_tpu.obs import emit_memory
 
     stall_frac = (round(stall.seconds / seconds, 4) if seconds > 0 else 0.0)
@@ -107,6 +110,15 @@ def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
                    samples_s=[], closes_epoch=True,
                    **timer.percentiles(), shapes=timer.shape_summary())
     emit_memory(telemetry, where=f"{phase}_epoch_{epoch}_end")
+    ledger = getattr(telemetry, "ledger", None)
+    if ledger is not None:
+        # the timer is per-epoch (fresh in _arm_telemetry), so these
+        # totals are this epoch's increment; the ledger accumulates
+        # run-wide.  The summary covers ALL programs the ledger knows
+        # (train + eval + serve share one ledger), so last-wins gauge
+        # semantics stay coherent whichever phase emitted last.
+        ledger.observe_timer(f"{phase}_step", timer)
+        ledger.emit_summary(telemetry, step=epoch, phase=phase)
 
 
 def _emit_step_window(telemetry, samples, *, steps: int, phase: str,
@@ -161,6 +173,16 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
         health = None
     train_step, timer, stall = _arm_telemetry(telemetry, train_step,
                                               name="train_step")
+    # span tracing (obs/spans.py): one trace per epoch, a child span pair
+    # per metric-flush window (steps / metric_flush) plus a synthesized
+    # fetch_stall span — the step-scoped timeline the ISSUE's "where did
+    # the milliseconds go" question needs.  None on default runs.
+    spans = (getattr(telemetry, "spans", None)
+             if telemetry is not None else None)
+    trace_id = root_id = None
+    if spans is not None:
+        trace_id = spans.new_trace_id(f"train.e{epoch}")
+        root_id = spans.new_span_id()  # root emitted at epoch end
     loss_sum = 0.0
     img_sum = 0.0
     flushed_img = 0.0  # img_sum at the last window flush (per-window delta)
@@ -187,6 +209,7 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
         pending.append(metrics)
         steps += 1
         if len(pending) >= max(check_every, 1):
+            t_flush = (time.perf_counter() if telemetry is not None else 0.0)
             loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum,
                                             check_finite, epoch, steps,
                                             health=health,
@@ -196,15 +219,24 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                 win_samples = timer.drain_window()
                 if health is not None:
                     health.on_window(win_samples, epoch=epoch, phase="train")
+                w0 = t_window
                 t_window = _emit_step_window(
                     telemetry, win_samples,
                     steps=steps - flushed_steps, phase="train",
                     epoch=epoch, t_window=t_window,
                     images=img_sum - flushed_img, **win)
+                if spans is not None:
+                    spans.emit(trace_id=trace_id, name="steps", start=w0,
+                               end=t_flush, parent_id=root_id, step=steps,
+                               steps=steps - flushed_steps)
+                    spans.emit(trace_id=trace_id, name="metric_flush",
+                               start=t_flush, end=t_window,
+                               parent_id=root_id, step=steps)
                 flushed_img = img_sum
                 flushed_steps = steps
             if show_progress and hasattr(it, "set_postfix") and img_sum:
                 it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
+    t_flush = (time.perf_counter() if telemetry is not None else 0.0)
     loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum, check_finite,
                                     epoch, steps, health=health,
                                     collect=telemetry is not None)
@@ -214,13 +246,33 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
         if tail or steps > flushed_steps:  # partial trailing window
             if health is not None:
                 health.on_window(tail, epoch=epoch, phase="train")
-            _emit_step_window(telemetry, tail, steps=steps - flushed_steps,
-                              phase="train", epoch=epoch, t_window=t_window,
-                              images=img_sum - flushed_img, **win)
+            w0 = t_window
+            t_end = _emit_step_window(
+                telemetry, tail, steps=steps - flushed_steps,
+                phase="train", epoch=epoch, t_window=t_window,
+                images=img_sum - flushed_img, **win)
+            if spans is not None:
+                spans.emit(trace_id=trace_id, name="steps", start=w0,
+                           end=t_flush, parent_id=root_id, step=steps,
+                           steps=steps - flushed_steps)
+                spans.emit(trace_id=trace_id, name="metric_flush",
+                           start=t_flush, end=t_end, parent_id=root_id,
+                           step=steps)
         _emit_epoch_telemetry(telemetry, timer, stall, phase="train",
                               epoch=epoch, seconds=seconds, health=health)
         if health is not None:
             health.epoch_summary(epoch)
+        if spans is not None:
+            # fetch_stall is SYNTHESIZED (start anchored at epoch start,
+            # duration = the StallClock's accumulated input starvation) —
+            # the stall events carry the exact accounting; the span gives
+            # the exported timeline a fetch lane to eyeball against steps
+            spans.emit(trace_id=trace_id, name="fetch_stall", start=t0,
+                       end=t0 + stall.seconds, parent_id=root_id,
+                       synthesized=True, count=stall.count)
+            spans.emit(trace_id=trace_id, name="train_epoch", start=t0,
+                       end=time.perf_counter(), span_id=root_id,
+                       epoch=epoch, steps=steps, images=img_sum)
     stats = EpochStats(loss_sum / max(img_sum, 1.0), seconds=seconds,
                        images=img_sum, steps=steps,
                        distinct_shapes=len(shapes))

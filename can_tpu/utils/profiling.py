@@ -188,6 +188,14 @@ class StepTimer:
                 "p95_s": float(np.percentile(arr, 95)),
                 "max_s": float(arr.max())}
 
+    def shape_totals(self) -> dict:
+        """Raw per-shape accounting, ``{shape: (n, total_s)}`` — the
+        lossless feed the ProgramCostLedger joins against compiled-program
+        flops (``shape_summary`` stringifies keys and rounds, which is
+        right for the JSONL payload and wrong for arithmetic)."""
+        return {shape: (n, total) for shape, (n, total)
+                in self._shapes.items()}
+
     def shape_summary(self) -> dict:
         """Per-bucket breakdown: ``{shape_str: {n, total_s, mean_s}}``."""
         return {str(shape): {"n": n, "total_s": round(total, 4),

@@ -612,7 +612,11 @@ class TestMetricsE2E:
         from can_tpu.data import make_synthetic_dataset
 
         root = str(tmp_path / "data")
-        for split, n, seed in (("train", 8, 0), ("test", 4, 1)):
+        # 32 train images = 4 steps/epoch on the 8-device test mesh
+        # (global batch 8): the train program crosses the ledger's
+        # MIN_UNFENCED_LAUNCHES trust threshold during epoch 1, so the
+        # MFU gauges the scrape waits for exist well before the run ends
+        for split, n, seed in (("train", 32, 0), ("test", 8, 1)):
             make_synthetic_dataset(os.path.join(root, f"{split}_data"), n,
                                    sizes=((64, 64),), seed=seed)
         s = socket.socket()
@@ -635,7 +639,8 @@ class TestMetricsE2E:
             except OSError:
                 time.sleep(0.05)
                 continue
-            if "can_tpu_grad_norm" in body and "can_tpu_loss" in body:
+            if ("can_tpu_grad_norm" in body and "can_tpu_loss" in body
+                    and "can_tpu_mfu_weighted" in body):
                 got = body
                 break
             time.sleep(0.05)
@@ -647,6 +652,11 @@ class TestMetricsE2E:
                    if l and not l.startswith("#")}
         assert {"can_tpu_step", "can_tpu_loss", "can_tpu_grad_norm",
                 "can_tpu_update_norm", "can_tpu_steps_total"} <= metrics
+        # the perf-attribution gauges (r9): per-program cost analysis
+        # joined with step timings — MFU + roofline class live mid-run
+        assert {"can_tpu_mfu_weighted", "can_tpu_roofline_compute_bound",
+                "can_tpu_roofline_memory_bound",
+                "can_tpu_perf_programs"} <= metrics
         # the detectors were armed: one health.summary per epoch in the
         # artifact (quiet run, so alerts_total stays 0)
         events = obs.read_events(
@@ -657,6 +667,22 @@ class TestMetricsE2E:
         # grad-norm gauges rode the step_window payloads
         assert any("grad_norm" in e["payload"] for e in events
                    if e["kind"] == "step_window")
+        # the perf-attribution artifact trail (r9): per-epoch
+        # perf.summary with a train_step row carrying real
+        # cost_analysis flops, and the train loop's span tree
+        perfs = [e for e in events if e["kind"] == "perf.summary"]
+        assert perfs, "no perf.summary in the artifact"
+        detail = perfs[-1]["payload"]["detail"]
+        train_rows = [r for r in detail if r["name"] == "train_step"]
+        assert train_rows and train_rows[0]["flops"] > 0
+        assert train_rows[0]["roofline"] in ("compute", "memory")
+        assert any(r["mfu"] is not None for r in train_rows)
+        span_names = {e["payload"]["name"] for e in events
+                      if e["kind"] == "trace.span"}
+        assert {"train_epoch", "steps", "metric_flush"} <= span_names
+        # compile events carry the cost analysis when the ledger is armed
+        assert any((e["payload"].get("flops") or 0) > 0 for e in events
+                   if e["kind"] == "compile")
 
 
 # --- heartbeat seq/start_ts (restart discrimination) --------------------

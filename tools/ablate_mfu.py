@@ -21,6 +21,18 @@ Variants (cumulative exclusion, finest first):
   quarter     — + convs 4-6 (1/4 res, 256ch)
   full_remat  — jax.checkpoint of the whole forward (the r2 ablation)
 
+Since round 9 the tool reports through the perf-attribution layer
+instead of hand math: each variant's step is wrapped in
+``obs.RecompileTracker`` with a ``ProgramCostLedger`` on the bus, so its
+XLA ``cost_analysis()`` flops/bytes are read at compile time and joined
+with the measured steady-state step time against the device peak table
+(``cli/common.py local_device_peaks``) — the JSON now carries per-variant
+**MFU**, HBM-bandwidth utilisation, and the roofline class next to
+img/s, which is exactly the compute-vs-bandwidth split the remat
+variants exist to probe.  On CPU the peak table is labelled NOMINAL:
+MFU values are relative-only there (the variant ORDERING is still
+meaningful, the absolute numbers are not).
+
 Run on the chip: ``python tools/ablate_mfu.py`` (~2 min; one compile per
 variant).  CPU smoke: ``ABLATE_PLATFORM=cpu ABLATE_STEPS=2 ABLATE_BATCH=2
 ABLATE_H=64 ABLATE_W=64 python tools/ablate_mfu.py``.
@@ -89,12 +101,23 @@ def main() -> None:
         "full_remat": dict(remat=True),
     }
 
+    # the perf-attribution ledger: per-variant cost_analysis() at compile
+    # time (via RecompileTracker), steady-state seconds observed after the
+    # timed loop, MFU/roofline against the device peak table
+    from can_tpu.obs import ProgramCostLedger, RecompileTracker, Telemetry
+
+    tel = Telemetry()
+    tel.ledger = ledger = ProgramCostLedger(compute="bf16")
+
     results = {}
     losses = {}
     for name, kw in variants.items():
         state = create_train_state(cannet_init(jax.random.key(0)), opt)
         step = make_dp_train_step(cannet_apply, opt, mesh,
                                   compute_dtype=jnp.bfloat16, **kw)
+        # per-variant tracker name => per-variant ledger row (the image
+        # signature alone is identical across variants)
+        step = RecompileTracker(step, tel, name=name)
         for _ in range(3):
             state, metrics = step(state, gbatch)
         float(jax.device_get(metrics["loss"]))  # fence (tunnel-safe)
@@ -103,16 +126,36 @@ def main() -> None:
             state, metrics = step(state, gbatch)
         losses[name] = float(jax.device_get(metrics["loss"]))
         dt = time.perf_counter() - t0
+        ledger.observe(name, gbatch["image"].shape, dt, n=steps)
         results[name] = round(local_b * steps / dt, 2)
-        print(f"[ablate_mfu] {name:10s}: {results[name]:8.2f} img/s")
+        row = next(r for r in ledger.rows() if r["name"] == name)
+        # each field guards its own None: a half-reporting cost_analysis()
+        # can yield mfu without bw_util (flops but no bytes) or vice versa
+        parts = []
+        if row["mfu"] is not None:
+            parts.append(f"MFU {row['mfu']:.3f}")
+        if row["bw_util"] is not None:
+            parts.append(f"bw {row['bw_util']:.3f}")
+        if row["roofline"] not in (None, "unknown"):
+            parts.append(f"[{row['roofline']}-bound]")
+        print(f"[ablate_mfu] {name:10s}: {results[name]:8.2f} img/s"
+              + ("  " + "  ".join(parts) if parts else "  (no cost analysis)"))
 
     # remat changes memory/bandwidth, never math: same-trajectory check
     base = losses["baseline"]
     for name, loss in losses.items():
         assert np.isfinite(loss) and abs(loss - base) / abs(base) < 5e-2, (
             name, loss, base)
+    rows = {r["name"]: {"mfu": r["mfu"], "bw_util": r["bw_util"],
+                        "roofline": r["roofline"],
+                        "gflops": (round(r["flops"] / 1e9, 2)
+                                   if r["flops"] else None)}
+            for r in ledger.rows()}
+    peaks = ledger.peaks
     print(json.dumps({"config": f"{h}x{w} b{b} bf16 x{steps}steps",
-                      "img_per_s": results}))
+                      "img_per_s": results, "mfu": rows,
+                      "peak_source": peaks.source if peaks else None,
+                      "peak_nominal": bool(peaks and peaks.nominal)}))
 
 
 if __name__ == "__main__":

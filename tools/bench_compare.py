@@ -51,14 +51,25 @@ def load_suite(path: str) -> dict:
 
 
 def _direction(unit: str) -> int:
-    """+1 when bigger is better (rates), -1 when smaller is (durations),
-    0 unknown (never gates)."""
+    """+1 when bigger is better (rates), -1 when smaller is (durations,
+    and compiled-program costs: the perf-ledger tier's gflops, where
+    creeping UP means a model/XLA change bloated the program), 0 unknown
+    (never gates)."""
     u = (unit or "").lower()
     if "/sec" in u or "/s" in u:
         return +1
-    if u in ("seconds", "s", "ms"):
+    if u in ("seconds", "s", "ms", "gflops"):
         return -1
     return 0
+
+
+def _two_sided(unit: str) -> bool:
+    """Deterministic compiled-cost metrics gate on ANY move beyond the
+    floor: the perf-ledger tier's gflops come from XLA cost_analysis(),
+    so a DROP is not an improvement — it means the program lost work
+    (e.g. a layer accidentally removed), the other half of the 'trips
+    when a model/XLA change moves a compiled program's cost' contract."""
+    return (unit or "").lower() == "gflops"
 
 
 def compare(old: dict, new: dict, *,
@@ -74,7 +85,8 @@ def compare(old: dict, new: dict, *,
                          "verdict": "added" if o is None else "removed"})
             continue
         ov, nv = o.get("value"), n.get("value")
-        sign = _direction(n.get("unit", o.get("unit", "")))
+        unit = n.get("unit", o.get("unit", ""))
+        sign = _direction(unit)
         if ov is None or nv is None or sign == 0 or ov == 0:
             # null results (watchdog timeouts) and unknown units are
             # reported, never silently gated on
@@ -85,7 +97,10 @@ def compare(old: dict, new: dict, *,
                         float(n.get("spread_pct") or 0.0),
                         float(default_spread_pct))
         delta_pct = 100.0 * (nv - ov) / abs(ov)
-        worse = -sign * delta_pct  # positive = moved in the bad direction
+        # positive = moved in the bad direction (either direction is bad
+        # for two-sided deterministic-cost units)
+        worse = (abs(delta_pct) if _two_sided(unit)
+                 else -sign * delta_pct)
         if worse > floor_pct:
             verdict = "regression"
         elif -worse > floor_pct:

@@ -8,6 +8,12 @@
 #   tools/ci_bench_gate.sh                    # vs BENCH_SUITE_r07.json
 #   tools/ci_bench_gate.sh MY_BASELINE.json
 #
+#   CI_BENCH_ONLY=perf tools/ci_bench_gate.sh PERF_LEDGER_cpu_r09.json
+#       gates the perf-attribution ledger instead of the host tier: the
+#       fresh run's per-program gflops (deterministic XLA cost_analysis)
+#       vs the committed artifact — trips when a model/XLA change moves a
+#       compiled program's cost, with MFU/roofline riding as context
+#
 # Environment knobs:
 #   CI_BENCH_OUT           where the fresh run's records land
 #                          (default /tmp/ci_bench_suite.jsonl)
@@ -33,7 +39,13 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # two steps, not a pipe: POSIX sh has no pipefail, and `suite | grep`
     # would let a mid-run bench crash ship a truncated-but-green artifact
     RAW=${OUT}.raw
+    # BENCH_PERF_LEDGER_OUT: the perf tier's artifact defaults to the
+    # committed PERF_LEDGER_cpu_r09.json in the repo root — which is the
+    # BASELINE this gate compares against.  Route the fresh run's copy
+    # elsewhere or the gate would overwrite its own baseline before
+    # reading it and pass vacuously.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi

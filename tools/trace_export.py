@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Convert ``trace.span`` telemetry JSONL into Chrome trace-event JSON.
+
+The span tree a run emits (``can_tpu/obs/spans.py`` — serve requests'
+submit→queue→assembly→device→respond, the train loop's per-window
+steps/metric_flush lanes) is viewable in ``chrome://tracing`` or Perfetto
+once converted to the trace-event format::
+
+    python tools/trace_export.py runs/exp1/telemetry.host0.jsonl
+    python tools/trace_export.py runs/exp1/ --out run.trace.json
+    python tools/trace_export.py tel.jsonl --trace-id req-1f03-7
+
+Mapping: every span becomes one complete event (``ph: "X"``) with
+microsecond ``ts``/``dur`` normalised to each HOST's earliest span (spans
+carry the emitter's own clock — service-monotonic for serve,
+``perf_counter`` for the train loop — whose epoch is process-local, so a
+cross-host export re-anchors hosts against each other via the bus
+wall-clock ``ts``); ``pid`` is the telemetry ``host_id`` and each trace_id gets
+its own ``tid`` lane plus a ``thread_name`` metadata event, so one
+request/epoch reads as one horizontal track.  Span/parent ids ride in
+``args`` for tooling that wants to rebuild the tree.
+
+Pure host-side file reading — no JAX import, safe anywhere the artifact
+was copied to (same contract as tools/telemetry_report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from can_tpu.obs.report import read_events_counted  # noqa: E402
+
+_SPAN_KEYS = ("trace_id", "span_id", "parent_id", "name",
+              "start_s", "duration_s")
+
+
+def spans_to_trace_events(events, *, trace_id: Optional[str] = None) -> dict:
+    """``trace.span`` events -> a Chrome trace-event document
+    (``{"traceEvents": [...], "displayTimeUnit": "ms"}``).
+
+    Lanes (``tid``) are assigned per trace_id in order of first
+    appearance — deterministic for a given artifact.  ``trace_id``
+    filters to one request/epoch tree."""
+    spans = [e for e in events if e.get("kind") == "trace.span"]
+    if trace_id is not None:
+        spans = [e for e in spans
+                 if e.get("payload", {}).get("trace_id") == trace_id]
+    out: List[dict] = []
+    lanes: dict = {}
+    # span start_s is the EMITTER's clock (perf_counter / service
+    # monotonic), whose epoch is process-local — a global min across
+    # hosts would offset lanes by arbitrary inter-host clock deltas.
+    # Normalise per host, then re-anchor hosts against each other with
+    # the bus wall-clock ``ts`` each event also carries (cross-host skew
+    # is then bounded by emit latency, not clock-epoch differences).
+    base: dict = {}       # host_id -> min start_s (that host's clock)
+    wall0: dict = {}      # host_id -> min bus ts (wall clock)
+    for e in spans:
+        p = e.get("payload", {})
+        if "start_s" not in p:
+            continue
+        h = int(e.get("host_id", 0))
+        base[h] = min(base.get(h, float("inf")), float(p["start_s"]))
+        wall0[h] = min(wall0.get(h, float("inf")), float(e.get("ts", 0.0)))
+    global_wall0 = min(wall0.values(), default=0.0)
+    for e in spans:
+        p = e.get("payload", {})
+        if "start_s" not in p or "duration_s" not in p:
+            continue  # malformed span: skip, exactly like a torn line
+        tid_key = str(p.get("trace_id", "?"))
+        pid = int(e.get("host_id", 0))
+        if (pid, tid_key) not in lanes:
+            lanes[(pid, tid_key)] = len(lanes) + 1
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": lanes[(pid, tid_key)],
+                        "args": {"name": tid_key}})
+        args = {k: v for k, v in p.items()
+                if k not in ("name", "start_s", "duration_s")}
+        out.append({
+            "name": str(p.get("name", "?")),
+            "cat": "can_tpu",
+            "ph": "X",
+            "ts": round(((float(p["start_s"]) - base[pid])
+                         + (wall0[pid] - global_wall0)) * 1e6, 3),
+            "dur": round(float(p["duration_s"]) * 1e6, 3),
+            "pid": pid,
+            "tid": lanes[(pid, tid_key)],
+            "args": args,
+        })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def resolve_paths(target: str) -> list:
+    if os.path.isdir(target):
+        paths = sorted(glob.glob(os.path.join(target,
+                                              "telemetry.host*.jsonl")))
+        if not paths:
+            raise SystemExit(f"no telemetry.host*.jsonl files in {target}")
+        return paths
+    if not os.path.isfile(target):
+        raise SystemExit(f"no such file or directory: {target}")
+    return [target]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("target", help="telemetry JSONL file, or a directory "
+                                  "holding telemetry.host*.jsonl")
+    p.add_argument("--out", default="",
+                   help="output path (default <target>.trace.json; '-' "
+                        "writes the JSON to stdout)")
+    p.add_argument("--trace-id", default=None,
+                   help="export only this trace's span tree (the id a "
+                        "serve response returns)")
+    args = p.parse_args(argv)
+    events = []
+    for path in resolve_paths(args.target):
+        evs, _ = read_events_counted(path)
+        events.extend(evs)
+    doc = spans_to_trace_events(events, trace_id=args.trace_id)
+    n = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+    if not n:
+        print("no trace.span events found"
+              + (f" for trace_id {args.trace_id}" if args.trace_id else "")
+              + " (run with --telemetry-dir to record spans)",
+              file=sys.stderr)
+        return 1
+    if args.out == "-":
+        json.dump(doc, sys.stdout)
+        return 0
+    out = args.out or (args.target.rstrip("/") + ".trace.json")
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(f"[trace_export] wrote {n} spans to {out} "
+          f"(open in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
