@@ -676,6 +676,171 @@ def bench_perf_ledger(jnp, compute_dtype, *, n_images=32, batch=2,
     return records
 
 
+def bench_bn(jnp, compute_dtype, *, b=2, h=64, w=64, steps=3,
+             out_path=None) -> list:
+    """BatchNorm-moments tier: the syncBN train step per moments path —
+    plain (no-BN ceiling) vs masked-twopass vs onepass vs pallas
+    (interpret mode off-TPU) — attributed through the ProgramCostLedger.
+
+    Two gateable records per variant, both from deterministic XLA
+    ``cost_analysis()`` (same contract as the perf tier):
+
+    * unit ``gflops`` — two-sided (a BN path must not silently gain or
+      lose work);
+    * unit ``gbytes`` — gated UPWARD only (bytes growing = the moments
+      path lost a fusion; shrinking is the improvement this tier exists
+      to hold).  The r10 acceptance pin rides this artifact: the onepass
+      rows must show strictly fewer bytes than the twopass rows
+      (tests/test_batchnorm.py::TestBNBenchArtifact).
+
+    img/s and MFU ride as informational extras (CPU timing noise — the
+    committed artifact's numbers gate nothing).  A running-stats parity
+    delta vs twopass is recorded per variant: the bench double-checks the
+    test suite's numerics pin on the exact shapes it prices.
+    """
+    import functools
+
+    import jax
+
+    from can_tpu.data.batching import Batch
+    from can_tpu.models import cannet_apply, cannet_init, init_batch_stats
+    from can_tpu.models.cannet import LocalOps
+    from can_tpu.obs.costs import ProgramCostLedger
+    from can_tpu.ops.bn_moments import make_bn_ops
+    from can_tpu.parallel import make_dp_train_step, make_global_batch, make_mesh
+    from can_tpu.train import (
+        batch_signature,
+        create_train_state,
+        make_lr_schedule,
+        make_optimizer,
+    )
+
+    ndev = jax.device_count()
+    mesh = make_mesh()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rng = np.random.default_rng(0)
+    local_b = b * ndev
+    # real padding in the batch so the MASKED moments are what's priced:
+    # the last /8-row of every map is bucket padding and the final slot is
+    # a dead fill slot — all-ones masks would let XLA fold the multiply
+    pm = np.ones((local_b, h // 8, w // 8, 1), np.float32)
+    pm[:, -1] = 0.0
+    sm = np.ones((local_b,), np.float32)
+    sm[-1] = 0.0
+    batch = Batch(
+        image=rng.normal(size=(local_b, h, w, 3)).astype(np.float32),
+        dmap=rng.uniform(size=(local_b, h // 8, w // 8, 1)).astype(np.float32),
+        pixel_mask=pm,
+        sample_mask=sm,
+    )
+    gbatch = make_global_batch(batch, mesh)
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=ndev))
+    plain_params = cannet_init(jax.random.key(0))
+    bn_params = cannet_init(jax.random.key(0), batch_norm=True)
+
+    variants = [("plain", "none"), ("syncbn_twopass", "twopass"),
+                ("syncbn_onepass", "onepass"), ("syncbn_pallas", "pallas")]
+    tag = "f32" if compute_dtype is None else "bf16"
+    compute = "bf16" if compute_dtype is not None else "f32"
+    records = []
+    detail = []
+    stats_by_variant = {}
+    for name, impl in variants:
+        if impl == "pallas" and ndev > 1:
+            # same refusal as the train CLI: pallas_call has no GSPMD
+            # partitioning rule, and this tier prices the jit-sharded dp
+            # step — a forced gather would corrupt the A/B bytes.  The
+            # committed baseline is devices=1 (like the perf tier).
+            print(f"# bn tier: skipping {name} on the {ndev}-device GSPMD "
+                  "dp step (no pallas partitioning rule)", flush=True)
+            continue
+        ledger = ProgramCostLedger(compute=compute)
+        if impl == "none":
+            apply_fn, params, stats = cannet_apply, plain_params, None
+        else:
+            bn_ops = make_bn_ops(impl, interpret=not on_tpu)
+            apply_fn = (cannet_apply if bn_ops is None else
+                        functools.partial(cannet_apply,
+                                          ops=LocalOps(bn_ops=bn_ops)))
+            params, stats = bn_params, init_batch_stats(bn_params)
+        state = create_train_state(params, opt, stats)
+        step = make_dp_train_step(apply_fn, opt, mesh, donate=False,
+                                  compute_dtype=compute_dtype)
+        # deterministic cost BEFORE the timed loop (registration also
+        # pays the compile, so the loop below times steady state)
+        ledger.register(name, batch_signature(gbatch), fn=step,
+                        args=(state, gbatch))
+        state, metrics = step(state, gbatch)  # warm + the parity state
+        float(jax.device_get(metrics["loss"]))
+        if state.batch_stats is not None:
+            stats_by_variant[name] = jax.device_get(state.batch_stats)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, gbatch)
+        float(jax.device_get(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        ledger.observe(name, gbatch["image"].shape, dt, n=steps)
+        (row,) = ledger.rows()
+        parity = None
+        if name in stats_by_variant and "syncbn_twopass" in stats_by_variant \
+                and name != "syncbn_twopass":
+            ref = stats_by_variant["syncbn_twopass"]
+            got = stats_by_variant[name]
+            # scale-relative per leaf (max delta over the leaf's own max
+            # magnitude): elementwise relative error on near-zero running
+            # -stat entries would read bf16 rounding as divergence
+            parity = max(
+                float(np.max(np.abs(np.asarray(a) - np.asarray(b)))
+                      / max(float(np.max(np.abs(np.asarray(b)))), 1e-6))
+                for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref)))
+        extra = dict(
+            bytes_gb=(round(row["bytes_accessed"] / 1e9, 4)
+                      if row["bytes_accessed"] else None),
+            img_per_s=round(local_b * steps / dt, 2),
+            mean_step_s=row["mean_s"], mfu=row["mfu"],
+            roofline=row["roofline"], interpret=(impl == "pallas"
+                                                 and not on_tpu),
+            parity_vs_twopass_max_rel=(round(parity, 6)
+                                       if parity is not None else None),
+        )
+        stem = f"bn_train_{h}x{w}_b{b}_{tag}_{name}"
+        recs = []
+        if row["flops"]:
+            # same rule as bytes below: a backend that stops reporting
+            # flops must fail the gate loudly (missing metric -> removed/
+            # min-overlap), never pass vacuously on an incomparable null
+            recs.append({"metric": stem, "unit": "gflops",
+                         "value": round(row["flops"] / 1e9, 3), **extra})
+        if row["bytes_accessed"]:
+            recs.append({"metric": f"bn_bytes_{h}x{w}_b{b}_{tag}_{name}",
+                         "value": round(row["bytes_accessed"] / 1e9, 4),
+                         "unit": "gbytes", "variant": name})
+        for r in recs:
+            records.append(r)
+            if _TELEMETRY is not None:
+                _TELEMETRY.emit("bench", **r)
+            print(json.dumps(r), flush=True)
+        detail.extend(ledger.rows())
+
+    out = out_path or os.environ.get("BENCH_BN_OUT")
+    if not out:
+        # committed gate baseline only for the EXPLICIT bn-only run, same
+        # rule as the perf tier's artifact
+        out = ("BENCH_BN_cpu_r10.json"
+               if os.environ.get("BENCH_SUITE_ONLY") == "bn"
+               else "BENCH_BN_local.json")
+    doc = {"metric": "bench_bn",
+           "config": {"b": b, "h": h, "w": w, "steps": steps, "tag": tag,
+                      "devices": ndev,
+                      "platform": jax.devices()[0].platform},
+           "detail": detail,
+           "results": records}
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# bn tier: {len(records)} records -> {out}", flush=True)
+    return records
+
+
 def bench_highres_eval(jnp, compute_dtype, *, h, w, steps, warmup=2):
     import jax
 
@@ -771,6 +936,8 @@ def main() -> None:
             bench_plan_space(repeats=2)
         if want("perf"):
             bench_perf_ledger(jnp, jnp.bfloat16)
+        if want("bn"):
+            bench_bn(jnp, jnp.bfloat16)
     else:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=16, h=576, w=768, steps=20)
@@ -807,6 +974,10 @@ def main() -> None:
             # baseline (PERF_LEDGER_cpu_r09.json) must be reproducible on
             # the CPU CI box either way
             bench_perf_ledger(jnp, jnp.bfloat16)
+        if want("bn"):
+            # same rule as the perf tier: one small config in both modes,
+            # reproducible on the CPU gate box (BENCH_BN_cpu_r10.json)
+            bench_bn(jnp, jnp.bfloat16)
 
     if _TELEMETRY is not None:
         from can_tpu.obs import emit_memory

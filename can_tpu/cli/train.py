@@ -232,6 +232,19 @@ def parse_args(argv=None):
                         "measures this host's dispatch overhead at "
                         "startup (sub-ms dispatch unlocks exact "
                         "straggler splits)")
+    p.add_argument("--bn-impl", choices=("twopass", "onepass", "pallas"),
+                   default="onepass",
+                   help="SyncBN batch-moments path (only meaningful with "
+                        "--syncBN): 'onepass' (default) computes per-channel "
+                        "(sum, sumsq, count) in one read of each BN layer's "
+                        "feature map and issues ONE packed collective per "
+                        "layer — measured strictly fewer HBM bytes per "
+                        "lowered program than 'twopass' (the original "
+                        "mean-then-variance math, kept bit-compatible for "
+                        "A/B, mirroring --plan-mode legacy); 'pallas' "
+                        "additionally fuses the mask multiply into a TPU "
+                        "kernel (ops/pallas_bn.py; jnp fallback off-TPU / "
+                        "unsupported shapes)")
     p.add_argument("--plan-mode", choices=("cost", "legacy"), default="cost",
                    help="batch-plan search: 'cost' (default) plans bucket "
                         "boundaries, per-cell batch sizes, and remnant "
@@ -403,9 +416,29 @@ def main(argv=None) -> int:
         print(f"[start] {datetime.datetime.now():%Y-%m-%d %H:%M:%S}")
     if args.syncBN and main_proc:
         print("[model] BatchNorm variant; stats sync across replicas via "
-              "global-batch reductions")
+              f"global-batch reductions (moments path: {args.bn_impl})")
 
     mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
+    # SyncBN moments path (ops/bn_moments.py): built only for --syncBN so
+    # a default run constructs nothing new — its lowered step must stay
+    # byte-identical (tests/test_batchnorm.py pins this)
+    bn_ops = None
+    if args.syncBN:
+        from can_tpu.ops.bn_moments import make_bn_ops
+
+        if args.bn_impl == "pallas":
+            if args.sp == 1 and dp > 1:
+                # pallas_call has no GSPMD partitioning rule: under the
+                # jit-sharded dp step it would force a gather; inside the
+                # sp shard_map body (per-device blocks) it composes fine
+                raise SystemExit("--bn-impl pallas needs --sp > 1 (the "
+                                 "kernel runs per-device inside shard_map) "
+                                 "or a single device; use onepass for the "
+                                 "GSPMD data-parallel step")
+            bn_ops = make_bn_ops("pallas",
+                                 interpret=jax.default_backend() != "tpu")
+        else:
+            bn_ops = make_bn_ops(args.bn_impl)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
         args.pad_multiple, args.sp)
@@ -558,6 +591,16 @@ def main(argv=None) -> int:
         import functools
 
         apply_fn = functools.partial(cannet_apply, s2d_stem=True)
+    if bn_ops is not None and args.sp == 1:
+        import functools
+
+        from can_tpu.models.cannet import LocalOps
+
+        # the BN-moments seam rides LocalOps beside context_fused; dp-path
+        # only (the sp step builds its own spatial ops and takes bn_ops
+        # directly below, so a bare LocalOps here would be a trap for any
+        # future sp-branch consumer of apply_fn)
+        apply_fn = functools.partial(apply_fn, ops=LocalOps(bn_ops=bn_ops))
     remat_policy = make_remat_policy(args.remat,
                                      global_batch=args.batch_size * dp,
                                      bf16=args.bf16, announce=main_proc,
@@ -567,7 +610,8 @@ def main(argv=None) -> int:
             lambda hw: make_sp_train_step(optimizer, mesh, hw,
                                           compute_dtype=compute_dtype,
                                           remat=remat_policy(hw),
-                                          health_metrics=instrument))
+                                          health_metrics=instrument,
+                                          bn_ops=bn_ops))
 
         def train_step(state, batch):
             return cache(tuple(batch["image"].shape[1:3]))(state, batch)

@@ -64,6 +64,13 @@ class LocalOps:
     # Optional fused context tail: (fv, [ave_k], [W_k], hw) -> fi
     # (ops/pallas_context.py provides the TPU kernel).
     context_fused: Any = None
+    # Optional BN-moments implementation (ops/bn_moments.py BNOps): the
+    # train-mode batch moments of every BN layer route through it —
+    # "onepass" reads the feature map once and issues ONE packed psum per
+    # layer instead of two, "pallas" additionally fuses the mask multiply
+    # into a VMEM-resident kernel (ops/pallas_bn.py).  None keeps the
+    # original two-pass math bit-for-bit (the A/B reference).
+    bn_ops: Any = None
     # Collective axis name(s) for cross-shard BatchNorm moments under
     # shard_map (SyncBN over an explicit mesh), plus the static total shard
     # count those axes span (for the unbiased-variance correction).  None
@@ -222,7 +229,7 @@ def cannet_apply(
             stats = None if batch_stats is None else batch_stats[group][i]
             y, updated = _batch_norm(y, p["bn"], stats, train, bn_momentum,
                                      axes=ops.bn_axes, n_shards=ops.bn_shards,
-                                     mask=mask)
+                                     mask=mask, bn_ops=ops.bn_ops)
             if new_stats is not None:
                 new_stats[group].append(updated)
         # checkpoint_name: identity outside jax.checkpoint; under a named
@@ -304,7 +311,7 @@ def context_block(cparams: Mapping, fv: jax.Array, *,
 
 def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
                 eps: float = 1e-5, *, axes=None, n_shards: int = 1,
-                mask=None):
+                mask=None, bn_ops=None):
     """torch-semantics BatchNorm2d over NHWC: normalize with biased batch
     var in train mode, update running stats with unbiased var; f32 stats.
 
@@ -321,38 +328,35 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
     ``axes`` (also exact for UNequal per-shard valid pixels, which the
     equal-shard pmean path can't represent).  mask=None keeps the
     original computation bit-for-bit.
+
+    ``bn_ops`` (ops/bn_moments.py BNOps, via ``LocalOps.bn_ops``) selects
+    HOW the train-mode moments are reduced — two-pass (default,
+    bit-compatible), one-pass packed-collective, or the Pallas kernel.
+    The s0 floor / all-fill running-stats guard below are
+    implementation-independent: every BNOps returns the same
+    (mean, biased var, global valid count) contract.
     """
     yf = y.astype(jnp.float32)
     if train:
+        if bn_ops is None:
+            from can_tpu.ops.bn_moments import BNOps
+
+            bn_ops = BNOps()
         if mask is not None:
             m = mask.astype(jnp.float32)  # (N, h, w, 1), matching y's NHW
-            s0 = jnp.sum(m)
-            s1 = jnp.sum(yf * m, axis=(0, 1, 2))
-            if axes:
-                s0 = jax.lax.psum(s0, axes)
-                s1 = jax.lax.psum(s1, axes)
-            # s0 floored at 1: an all-fill batch (every slot a dead
-            # remnant slot) has zero valid pixels, and 0/0 moments would
-            # NaN the whole output — the floor yields mean=var=0 instead,
-            # and the zero mask already erases the slots downstream
-            # (ADVICE r5)
-            den = jnp.maximum(s0, 1.0)
-            mean = s1 / den
-            ss = jnp.sum(jnp.square(yf - mean) * m, axis=(0, 1, 2))
-            if axes:
-                ss = jax.lax.psum(ss, axes)
-            var = ss / den
+            # s0 floored at 1 (inside masked_moments): an all-fill batch
+            # (every slot a dead remnant slot) has zero valid pixels, and
+            # 0/0 moments would NaN the whole output — the floor yields
+            # mean=var=0 instead, and the zero mask already erases the
+            # slots downstream (ADVICE r5)
+            mean, var, s0 = bn_ops.masked_moments(yf, m, axes)
             unbiased = var * (s0 / jnp.maximum(s0 - 1.0, 1.0))
             # an all-fill batch must also leave the RUNNING stats alone:
             # blending its mean=var=0 into the EMA would drag the stats
             # toward zero by one momentum step per occurrence
             momentum = momentum * jnp.where(s0 > 0.0, 1.0, 0.0)
         elif axes:
-            # two-pass global moments over the mesh: mean first, then the
-            # centered second moment (stabler than E[x^2] - E[x]^2)
-            mean = jax.lax.pmean(jnp.mean(yf, axis=(0, 1, 2)), axes)
-            var = jax.lax.pmean(
-                jnp.mean(jnp.square(yf - mean), axis=(0, 1, 2)), axes)
+            mean, var = bn_ops.global_moments(yf, axes)
         else:
             mean = jnp.mean(yf, axis=(0, 1, 2))
             var = jnp.var(yf, axis=(0, 1, 2))  # biased, for normalization

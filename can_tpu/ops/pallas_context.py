@@ -34,7 +34,11 @@ Conclusion recorded per VERDICT r1 item 9: XLA's automatic fusion of this
 block (including the concat that follows it) is simply better than the
 hand tiling here — the MXU matmuls dominate and XLA already keeps the
 intermediates out of HBM.  Use ``make_fused_context()`` directly if you
-want the kernel.
+want the kernel.  (Unchanged as of r10 — the conclusion is about THIS
+MXU-dominated block, not the pattern: the place the same tiling +
+custom-VJP discipline DOES pay is the pure-reduction masked SyncBN
+moments, ``ops/pallas_bn.py``, which wins on deterministic cost_analysis
+bytes rather than a timing race with XLA's fusion.)
 """
 
 from __future__ import annotations

@@ -31,9 +31,28 @@ def _adaptive_pool_matrix_np(in_size: int, out_size: int) -> np.ndarray:
     return m
 
 
+@functools.lru_cache(maxsize=None)
+def _adaptive_pool_matrix_jnp(in_size: int, out_size: int, dtype_name: str):
+    # first call often lands INSIDE a jit trace: without the eager scope
+    # the cache would capture that trace's tracer and poison every later
+    # trace (UnexpectedTracerError); with it the cache always holds a
+    # concrete device array, closed over as a constant thereafter
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_adaptive_pool_matrix_np(in_size, out_size),
+                           dtype=dtype_name)
+
+
 def adaptive_pool_matrix(in_size: int, out_size: int, dtype=jnp.float32):
-    """(out_size, in_size) row-stochastic averaging matrix (PyTorch bins)."""
-    return jnp.asarray(_adaptive_pool_matrix_np(in_size, out_size), dtype=dtype)
+    """(out_size, in_size) row-stochastic averaging matrix (PyTorch bins).
+
+    Cached by (in, out, dtype) as a device array, not just the numpy
+    build: every trace of every pooling site used to re-upload the same
+    tiny constant (13 BN-model conv layers x per-bucket-shape compiles
+    add up), and inside a trace the cached array is a plain closed-over
+    constant — numerically identical program, one host->device copy ever.
+    """
+    return _adaptive_pool_matrix_jnp(in_size, out_size,
+                                     np.dtype(dtype).name)
 
 
 def adaptive_avg_pool2d(x, output_size):

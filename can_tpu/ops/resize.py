@@ -43,9 +43,23 @@ def _upsample_matrix_np(in_size: int, out_size: int) -> np.ndarray:
     return m
 
 
+@functools.lru_cache(maxsize=None)
+def _upsample_matrix_jnp(in_size: int, out_size: int, dtype_name: str):
+    # eager scope for the same reason as pooling._adaptive_pool_matrix_jnp:
+    # a first call inside a jit trace must not cache that trace's tracer
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_upsample_matrix_np(in_size, out_size),
+                           dtype=dtype_name)
+
+
 def upsample_matrix(in_size: int, out_size: int, dtype=jnp.float32):
-    """(out_size, in_size) align-corners bilinear interpolation matrix."""
-    return jnp.asarray(_upsample_matrix_np(in_size, out_size), dtype=dtype)
+    """(out_size, in_size) align-corners bilinear interpolation matrix.
+
+    Cached by (in, out, dtype) as a device array (see
+    ``pooling.adaptive_pool_matrix``): the numpy build was already
+    lru-cached, but each call still paid a fresh ``jnp.asarray`` per
+    trace site per compile."""
+    return _upsample_matrix_jnp(in_size, out_size, np.dtype(dtype).name)
 
 
 def resize_bilinear_align_corners(x, size):

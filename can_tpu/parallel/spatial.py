@@ -74,7 +74,8 @@ def halo_exchange_rows(x: jax.Array, halo: int, axis_name: str,
 
 def make_spatial_ops(axis_name: str, axis_size: int,
                      feat_hw: Tuple[int, int], *,
-                     bn_axes=None, bn_shards: int = 1) -> LocalOps:
+                     bn_axes=None, bn_shards: int = 1,
+                     bn_ops=None) -> LocalOps:
     """LocalOps whose spatial primitives communicate over ``axis_name``.
 
     feat_hw: GLOBAL feature-map (H/8, W) shape after the VGG frontend — the
@@ -84,6 +85,11 @@ def make_spatial_ops(axis_name: str, axis_size: int,
     moments pmean over in train mode — (data, spatial) in the train step, so
     a BN model under dp x sp sees exactly the global-batch statistics
     (SyncBN; reference train.py:116-118).
+
+    bn_ops (ops/bn_moments.py BNOps): how each BN layer's moments are
+    reduced before the cross-shard collective — the shard_map body is
+    per-device, so the one-pass packed psum (and the Pallas local kernel)
+    compose with the mesh axes exactly like the two-pass default.
     """
 
     def conv2d_sp(x, w, b=None, *, dilation: int = 1, padding=None,
@@ -137,6 +143,7 @@ def make_spatial_ops(axis_name: str, axis_size: int,
         global_hw=feat_hw,
         bn_axes=bn_axes,
         bn_shards=bn_shards,
+        bn_ops=bn_ops,
     )
 
 
@@ -193,7 +200,8 @@ def make_spatial_apply(mesh: Mesh, image_hw: Tuple[int, int], *,
 def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
                        compute_dtype=None, donate: bool = True,
                        remat: bool = False,
-                       health_metrics: bool = False) -> Callable:
+                       health_metrics: bool = False,
+                       bn_ops=None) -> Callable:
     """Jitted train step with BOTH data and spatial parallelism.
 
     Batch dict layout: image (B, H, W, 3), dmap/pixel_mask (B, H/8, W/8, 1),
@@ -204,7 +212,10 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
     BN models (state.batch_stats is a tree) get SyncBN: batch moments are
     pmean'd over (data, spatial) inside the shard_map body, so statistics
     equal the global-batch ones exactly (reference train.py:116-118 made
-    real in every parallelism mode).
+    real in every parallelism mode).  ``bn_ops`` (ops/bn_moments.py)
+    selects the moments reduction — one-pass mode halves both the
+    activation reads and the per-BN-layer collective rounds (the packed
+    psum is one all-reduce where two-pass issues two).
 
     remat=True rematerialises the sharded forward in backward
     (``jax.checkpoint``) — the combination that serves very large images
@@ -218,7 +229,7 @@ def make_sp_train_step(optimizer, mesh: Mesh, image_hw: Tuple[int, int], *,
     feat_hw = (h // 8, w // 8)
     ops = make_spatial_ops(SPATIAL_AXIS, sp, feat_hw,
                            bn_axes=(DATA_AXIS, SPATIAL_AXIS),
-                           bn_shards=dp * sp)
+                           bn_shards=dp * sp, bn_ops=bn_ops)
 
     bspec = P(DATA_AXIS, SPATIAL_AXIS, None, None)
     batch_specs = {"image": bspec, "dmap": bspec, "pixel_mask": bspec,

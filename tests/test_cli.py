@@ -288,6 +288,28 @@ class TestTrainCLI:
                           "--show-index", "0", "--out-dir", str(viz)]) == 0
         assert any(f.endswith(".png") for f in os.listdir(viz))
 
+    def test_bn_impl_flag(self, data_root, tmp_path):
+        """--bn-impl (r10): default is the one-pass moments path; twopass
+        stays selectable end to end (the bit-compatible A/B anchor); the
+        pallas variant is rejected on the multi-device GSPMD dp step
+        (no partitioning rule — it needs --sp or a single device)."""
+        from can_tpu.cli.train import main as train_main, parse_args
+
+        assert parse_args(["--data_root", "x"]).bn_impl == "onepass"
+        ckdir = str(tmp_path / "ck_bn_twopass")
+        argv = ["--data_root", data_root, "--epochs", "1",
+                "--batch-size", "1", "--syncBN", "--bn-impl", "twopass",
+                "--checkpoint-dir", ckdir, "--seed", "0",
+                "--max-steps-per-epoch", "2"]
+        assert train_main(argv) == 0
+        # the conftest mesh is dp=8: pallas on the GSPMD dp path must be
+        # refused with the actionable message, BEFORE any training
+        with pytest.raises(SystemExit, match="pallas"):
+            train_main(["--data_root", data_root, "--epochs", "1",
+                        "--batch-size", "1", "--syncBN",
+                        "--bn-impl", "pallas",
+                        "--checkpoint-dir", str(tmp_path / "ck_bn_pl")])
+
     def test_explicit_split_roots(self, data_root, tmp_path):
         """VisDrone-style layouts: images and density maps in unrelated
         trees via explicit per-split roots (reference hardcodes such a

@@ -146,8 +146,78 @@ def main() -> None:
     for name, loss in losses.items():
         assert np.isfinite(loss) and abs(loss - base) / abs(base) < 5e-2, (
             name, loss, base)
+
+    # syncBN-variant sweep (r10): the moments-path A/B the --bn-impl flag
+    # exposes, attributed the same way — per-variant cost_analysis bytes
+    # is the number that argues the one-pass rebuild (two-pass streams
+    # each BN layer's activation through HBM twice).  ABLATE_SYNCBN=0
+    # skips (halves the chip time when only the remat axis is wanted).
+    if os.environ.get("ABLATE_SYNCBN", "1") != "0":
+        import functools
+
+        from can_tpu.models import init_batch_stats
+        from can_tpu.models.cannet import LocalOps
+        from can_tpu.ops.bn_moments import make_bn_ops
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        bn_losses = {}
+        for impl in ("twopass", "onepass", "pallas"):
+            if impl == "pallas" and ndev > 1:
+                # the train CLI's refusal, mirrored: no GSPMD partitioning
+                # rule for pallas_call — under the jit-sharded dp step the
+                # forced gather would corrupt exactly the A/B this sweep
+                # reports (run on 1 device or via --sp for this variant)
+                print("[ablate_mfu] syncbn_pallas: skipped on the "
+                      f"{ndev}-device GSPMD dp step")
+                continue
+            name = f"syncbn_{impl}"
+            bn_ops = make_bn_ops(impl, interpret=not on_tpu)
+            apply_fn = (cannet_apply if bn_ops is None else
+                        functools.partial(cannet_apply,
+                                          ops=LocalOps(bn_ops=bn_ops)))
+            # fresh params per variant: the step donates its state
+            bn_params = cannet_init(jax.random.key(0), batch_norm=True)
+            state = create_train_state(bn_params, opt,
+                                       init_batch_stats(bn_params))
+            step = make_dp_train_step(apply_fn, opt, mesh,
+                                      compute_dtype=jnp.bfloat16)
+            step = RecompileTracker(step, tel, name=name)
+            for _ in range(3):
+                state, metrics = step(state, gbatch)
+            float(jax.device_get(metrics["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, gbatch)
+            bn_losses[name] = float(jax.device_get(metrics["loss"]))
+            dt = time.perf_counter() - t0
+            ledger.observe(name, gbatch["image"].shape, dt, n=steps)
+            results[name] = round(local_b * steps / dt, 2)
+            row = next(r for r in ledger.rows() if r["name"] == name)
+            parts = []
+            if row["mfu"] is not None:
+                parts.append(f"MFU {row['mfu']:.3f}")
+            if row["bw_util"] is not None:
+                parts.append(f"bw {row['bw_util']:.3f}")
+            if row["bytes_accessed"]:
+                parts.append(f"{row['bytes_accessed'] / 1e9:.3f} GB")
+            if row["roofline"] not in (None, "unknown"):
+                parts.append(f"[{row['roofline']}-bound]")
+            print(f"[ablate_mfu] {name:16s}: {results[name]:8.2f} img/s"
+                  + ("  " + "  ".join(parts)
+                     if parts else "  (no cost analysis)"))
+        # the moments path changes reduction order, never the model: the
+        # variants must sit on one trajectory (vs each other, not vs the
+        # no-BN baseline — a BN model is a different model)
+        bn_base = bn_losses["syncbn_twopass"]
+        for name, loss in bn_losses.items():
+            assert np.isfinite(loss) and (
+                abs(loss - bn_base) / abs(bn_base) < 5e-2), (
+                name, loss, bn_base)
+
     rows = {r["name"]: {"mfu": r["mfu"], "bw_util": r["bw_util"],
                         "roofline": r["roofline"],
+                        "gbytes": (round(r["bytes_accessed"] / 1e9, 3)
+                                   if r["bytes_accessed"] else None),
                         "gflops": (round(r["flops"] / 1e9, 2)
                                    if r["flops"] else None)}
             for r in ledger.rows()}

@@ -53,12 +53,14 @@ def load_suite(path: str) -> dict:
 def _direction(unit: str) -> int:
     """+1 when bigger is better (rates), -1 when smaller is (durations,
     and compiled-program costs: the perf-ledger tier's gflops, where
-    creeping UP means a model/XLA change bloated the program), 0 unknown
-    (never gates)."""
+    creeping UP means a model/XLA change bloated the program; the bn
+    tier's gbytes, where creeping UP means a moments path lost a fusion
+    — shrinking bytes IS the improvement, so gbytes stays one-sided),
+    0 unknown (never gates)."""
     u = (unit or "").lower()
     if "/sec" in u or "/s" in u:
         return +1
-    if u in ("seconds", "s", "ms", "gflops"):
+    if u in ("seconds", "s", "ms", "gflops", "gbytes"):
         return -1
     return 0
 
@@ -70,6 +72,15 @@ def _two_sided(unit: str) -> bool:
     (e.g. a layer accidentally removed), the other half of the 'trips
     when a model/XLA change moves a compiled program's cost' contract."""
     return (unit or "").lower() == "gflops"
+
+
+# Deterministic units never take the TIMING default floor: cost_analysis()
+# values reproduce exactly run-to-run, so the 10% host-noise default would
+# swallow exactly the moves these tiers exist to catch (the bn tier's
+# onepass-vs-twopass bytes delta is ~2%; a lost fusion of that size must
+# trip).  0.1% absorbs the artifacts' own value rounding, nothing more.
+_DETERMINISTIC_UNITS = ("gflops", "gbytes")
+_DETERMINISTIC_FLOOR_PCT = 0.1
 
 
 def compare(old: dict, new: dict, *,
@@ -95,7 +106,9 @@ def compare(old: dict, new: dict, *,
             continue
         floor_pct = max(float(o.get("spread_pct") or 0.0),
                         float(n.get("spread_pct") or 0.0),
-                        float(default_spread_pct))
+                        (_DETERMINISTIC_FLOOR_PCT
+                         if unit.lower() in _DETERMINISTIC_UNITS
+                         else float(default_spread_pct)))
         delta_pct = 100.0 * (nv - ov) / abs(ov)
         # positive = moved in the bad direction (either direction is bad
         # for two-sided deterministic-cost units)

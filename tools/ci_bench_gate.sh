@@ -14,6 +14,11 @@
 #       vs the committed artifact — trips when a model/XLA change moves a
 #       compiled program's cost, with MFU/roofline riding as context
 #
+#   CI_BENCH_ONLY=bn tools/ci_bench_gate.sh BENCH_BN_cpu_r10.json
+#       gates the BatchNorm-moments tier: per-variant gflops (two-sided)
+#       AND cost_analysis bytes (unit gbytes, gated UPWARD — bytes
+#       growing = the syncBN moments path lost a fusion)
+#
 # Environment knobs:
 #   CI_BENCH_OUT           where the fresh run's records land
 #                          (default /tmp/ci_bench_suite.jsonl)
@@ -44,8 +49,12 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # BASELINE this gate compares against.  Route the fresh run's copy
     # elsewhere or the gate would overwrite its own baseline before
     # reading it and pass vacuously.
+    # BENCH_BN_OUT: same baseline-overwrite trap as the perf ledger — the
+    # bn tier's artifact defaults to the committed BENCH_BN_cpu_r10.json
+    # exactly when BENCH_SUITE_ONLY=bn, which is how this gate runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
+        BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi
