@@ -27,6 +27,12 @@ serves far bigger shapes; override for real runs):
     BENCH_SERVE_DEADLINE_MS=2000  open-loop request deadline
     BENCH_SERVE_SIZES=60x60,90x90,64x90,90x64   request resolutions
     BENCH_SERVE_OUT=local     report tag
+    BENCH_SERVE_REPLICAS=0    0/1 = single ServeEngine; >= 2 = FleetEngine
+                              with that many device-pinned replicas
+                              (artifact becomes BENCH_SERVE_FLEET_<tag>)
+    BENCH_SERVE_DTYPE=f32     predict-program mode (f32 | bf16 | int8);
+                              quantized modes also run the f32 parity
+                              ladder and record the graded rung
 """
 
 from __future__ import annotations
@@ -147,6 +153,15 @@ def run_open_loop(service, images, n_requests: int, rate_rps: float,
 
 
 def main() -> None:
+    if os.environ.get("BENCH_SERVE_PLATFORM") == "cpu8":
+        # 8 virtual CPU devices (the fleet needs one device per replica;
+        # same smoke-mesh trick as bench_suite BENCH_SUITE_PLATFORM=cpu8)
+        from __graft_entry__ import _ensure_cpu_flags
+
+        _ensure_cpu_flags(8)
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "96"))
     n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
     rate = float(os.environ.get("BENCH_SERVE_RATE", "0"))
@@ -154,13 +169,22 @@ def main() -> None:
     max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "5"))
     deadline_ms = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", "2000"))
     tag = os.environ.get("BENCH_SERVE_OUT", "local")
+    replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", "0"))
+    serve_dtype = os.environ.get("BENCH_SERVE_DTYPE", "f32")
     sizes = _sizes_from_env()
 
     import jax
 
     from can_tpu.models import cannet_init
     from can_tpu.obs import Telemetry
-    from can_tpu.serve import CountService, ServeEngine, prepare_image
+    from can_tpu.serve import (
+        CountService,
+        FleetEngine,
+        ServeEngine,
+        parity_report,
+        prepare_image,
+    )
+    from can_tpu.serve.quant import param_bytes
     from can_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache(None)  # no-op on CPU, warm restarts on TPU
@@ -172,7 +196,13 @@ def main() -> None:
     ladder = (tuple(sorted({-(-h // 8) * 8 for h, _ in sizes})),
               tuple(sorted({-(-w // 8) * 8 for _, w in sizes})))
     buckets = [(h, w) for h in ladder[0] for w in ladder[1]]
-    engine = ServeEngine(params, telemetry=telemetry)
+    fleet = replicas >= 2
+    if fleet:
+        engine = FleetEngine(params, replicas=replicas,
+                             serve_dtype=serve_dtype, telemetry=telemetry)
+    else:
+        engine = ServeEngine(params, serve_dtype=serve_dtype,
+                             telemetry=telemetry)
     service = CountService(engine, max_batch=max_batch,
                            max_wait_ms=max_wait_ms,
                            queue_capacity=max(64, 4 * max_batch),
@@ -186,6 +216,17 @@ def main() -> None:
         (rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8))
         for h, w in sizes]
 
+    # quantized modes carry a parity receipt: the same images through a
+    # fresh engine of this mode vs the f32 reference, graded on the
+    # committed count-delta tolerance ladder (serve/quant.py)
+    parity = None
+    if serve_dtype != "f32":
+        ref = ServeEngine(params, telemetry=telemetry, name="parity_f32")
+        quant = ServeEngine(params, serve_dtype=serve_dtype,
+                            telemetry=telemetry,
+                            name=f"parity_{serve_dtype}")
+        parity = parity_report(quant, ref, images)
+
     with service:
         closed = run_closed_loop(service, images, n_requests, n_clients)
         if rate <= 0:
@@ -194,26 +235,42 @@ def main() -> None:
                               deadline_ms)
     stats = service.stats()
 
+    # compile budget: one program per (bucket, dtype) PER replica engine
+    compile_budget = len(buckets) * max(replicas, 1)
     report = {
-        "metric": f"cannet_serve_b{max_batch}_w{int(max_wait_ms)}ms",
+        "metric": f"cannet_serve_b{max_batch}_w{int(max_wait_ms)}ms"
+                  + (f"_r{replicas}" if fleet else "")
+                  + (f"_{serve_dtype}" if serve_dtype != "f32" else ""),
         "unit": "ms latency / req_s",
         "config": {"requests": n_requests, "clients": n_clients,
                    "max_batch": max_batch, "max_wait_ms": max_wait_ms,
                    "deadline_ms": deadline_ms,
+                   "replicas": replicas if fleet else 1,
+                   "serve_dtype": serve_dtype,
                    "sizes": [f"{h}x{w}" for h, w in sizes],
                    "buckets": [f"{h}x{w}" for h, w in buckets],
                    "platform": jax.devices()[0].platform},
         "warmup": warm,
         "compile_count": engine.compile_count,
         "bucket_count": len(buckets),
-        "compiles_bounded": engine.compile_count <= len(buckets),
+        "compiles_bounded": engine.compile_count <= compile_budget,
+        # the tree the replicas actually hold resident — measuring it
+        # (instead of re-quantizing) cannot diverge from what is served
+        "param_bytes": param_bytes(
+            engine.replicas[0].engine.params if fleet else engine.params),
         "closed_loop": closed,
         "open_loop": open_,
         "mean_batch_fill": stats["mean_batch_fill"],
         "batches": stats["batches"],
         "wall_s": round(time.perf_counter() - t0, 3),
     }
-    out = f"BENCH_SERVE_{tag}.json"
+    if parity is not None:
+        report["parity_vs_f32"] = parity
+    if fleet:
+        report["replica_stats"] = stats["replicas"]
+        report["live_replicas"] = stats["live_replicas"]
+    out = (f"BENCH_SERVE_FLEET_{tag}.json" if fleet
+           else f"BENCH_SERVE_{tag}.json")
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report))

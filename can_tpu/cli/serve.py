@@ -80,9 +80,23 @@ def parse_args(argv=None):
                    help="default per-request deadline (expired requests "
                         "are rejected, never dispatched); requests may "
                         "override per call")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve-engine replicas, one per device of the "
+                        "mesh (>= 2 builds the FleetEngine: work-stealing "
+                        "dispatch, quarantine-on-failure, blue/green "
+                        "/rollout; 1 keeps the single-engine service)")
+    p.add_argument("--serve-dtype", type=str, default="f32",
+                   choices=["f32", "bf16", "int8"],
+                   help="predict-program mode (serve/quant.py): f32 = "
+                        "bit-parity with offline evaluate(); bf16 = bf16 "
+                        "params+compute at MXU rate; int8 = weight-only "
+                        "post-training quantization (per-channel scales, "
+                        "f32 accumulation, 4x smaller resident params) — "
+                        "each priced by the committed parity ladder")
     p.add_argument("--bf16", action="store_true",
-                   help="bf16 compute (MXU rate; counts shift ~1e-3 "
-                        "relative vs the f32 parity path)")
+                   help="LEGACY bf16 compute with f32 params (counts "
+                        "shift ~1e-3 relative); superseded by "
+                        "--serve-dtype bf16, conflict if both given")
     p.add_argument("--u8-warmup", action="store_true",
                    help="also pre-compile uint8-input programs, for "
                         "clients POSTing ?raw=1 (pixels stay bytes on the "
@@ -115,6 +129,64 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _run_config_for(checkpoint_dir, torch_pth, params_npz):
+    """Run config for the drift guard — imported .pth/.npz checkpoints
+    carry none, so the guard degrades to skipped for them (same as
+    resume).  One helper so serve-time and rollout-time agree forever."""
+    from can_tpu.utils import load_run_config
+
+    if torch_pth or params_npz:
+        return None
+    return load_run_config(checkpoint_dir)
+
+
+def make_rollout_loader(base_args):
+    """Checkpoint loader for the HTTP /rollout endpoint: a JSON source
+    spec (same keys as the CLI flags) -> (params, batch_stats,
+    run_config).  Reuses the eval CLI's validated loading path, so
+    anything you can serve you can roll out."""
+    import argparse as _ap
+
+    def load(spec: dict):
+        from can_tpu.cli.test import load_params, validate_params_source
+
+        allowed = {"checkpoint_dir", "epoch", "params_npz", "torch_pth",
+                   "syncBN"}
+        unknown = set(spec) - allowed
+        if unknown:
+            raise ValueError(f"unknown rollout keys: {sorted(unknown)} "
+                             f"(allowed: {sorted(allowed)})")
+        # an imported-source spec (torch_pth / params_npz) must NOT
+        # inherit the serving checkpoint_dir: validate_params_source
+        # rejects the combination, which would 409 every such rollout
+        imported = bool(spec.get("torch_pth") or spec.get("params_npz"))
+        ns = _ap.Namespace(
+            # default to the SERVING run's directory (a bare {"epoch": N}
+            # rolls forward within it), exactly like syncBN below — an
+            # unrelated ./checkpoints fallback could silently flip the
+            # fleet to a different run's weights
+            checkpoint_dir=spec.get(
+                "checkpoint_dir",
+                None if imported else base_args.checkpoint_dir),
+            epoch=spec.get("epoch"),
+            torch_pth=spec.get("torch_pth", ""),
+            params_npz=spec.get("params_npz", ""),
+            syncBN=bool(spec.get("syncBN", base_args.syncBN)),
+            seed=base_args.seed)
+        try:
+            validate_params_source(ns)
+            params, batch_stats = load_params(ns)
+        except SystemExit as e:
+            # the loading path speaks CLI (SystemExit); over HTTP that
+            # must become a 409-able error, not a dead handler thread
+            raise ValueError(str(e)) from None
+        run_config = _run_config_for(ns.checkpoint_dir, ns.torch_pth,
+                                     ns.params_npz)
+        return params, batch_stats, run_config
+
+    return load
+
+
 def build_service(args, telemetry=None):
     """Engine + service from parsed args (no networking) — the seam the
     tests and bench drive; ``main`` adds HTTP around it."""
@@ -122,12 +194,28 @@ def build_service(args, telemetry=None):
     import numpy as np
 
     from can_tpu.cli.test import load_params
-    from can_tpu.serve import CountService, ServeEngine
+    from can_tpu.serve import CountService, FleetEngine, ServeEngine
 
+    if args.bf16 and args.serve_dtype != "f32":
+        raise SystemExit("--bf16 is the legacy f32-params/bf16-compute "
+                         "mode; with --serve-dtype use the mode itself "
+                         "(drop --bf16)")
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
     params, batch_stats = load_params(args)
-    engine = ServeEngine(params, batch_stats,
-                         compute_dtype=jnp.bfloat16 if args.bf16 else None,
-                         telemetry=telemetry)
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    if args.replicas > 1:
+        run_config = _run_config_for(args.checkpoint_dir, args.torch_pth,
+                                     args.params_npz)
+        engine = FleetEngine(params, batch_stats, replicas=args.replicas,
+                             serve_dtype=args.serve_dtype,
+                             compute_dtype=compute_dtype,
+                             telemetry=telemetry, run_config=run_config)
+    else:
+        engine = ServeEngine(params, batch_stats,
+                             serve_dtype=args.serve_dtype,
+                             compute_dtype=compute_dtype,
+                             telemetry=telemetry)
     high_water = (args.high_water if args.high_water is not None
                   else max(1, (3 * args.queue_capacity) // 4))
     shapes = args.bucket_shapes
@@ -139,13 +227,19 @@ def build_service(args, telemetry=None):
                            high_water=high_water,
                            default_deadline_ms=args.deadline_ms,
                            bucket_ladder=ladder, telemetry=telemetry)
+    if args.replicas > 1:
+        # the /rollout endpoint's checkpoint loader (fleet only: a single
+        # engine has no staging replica to warm on)
+        service.rollout_loader = make_rollout_loader(args)
     # the ladder's cross product is the compile universe; warm it ALL so
     # no live request ever pays a compile
     grid = [(h, w) for h in ladder[0] for w in ladder[1]]
     dtypes = (np.float32, np.uint8) if args.u8_warmup else (np.float32,)
     report = service.warmup(grid, dtypes=dtypes)
+    reps = f" x {args.replicas} replicas" if args.replicas > 1 else ""
     print(f"[serve] warmup: {report['compiles']} programs over "
-          f"{report['shapes']} bucket shapes in {report['seconds']:.1f}s")
+          f"{report['shapes']} bucket shapes{reps} "
+          f"[{args.serve_dtype}] in {report['seconds']:.1f}s")
     return service
 
 
@@ -174,8 +268,11 @@ def main(argv=None) -> int:
             exporter.add_stats_source("serve", service.stats)
         with service:
             httpd = serve_http(service, host=args.host, port=args.port)
+            endpoints = "POST /predict, GET /healthz, GET /stats"
+            if args.replicas > 1:
+                endpoints += ", POST /rollout"
             print(f"[serve] listening on http://{args.host}:{args.port} "
-                  f"(POST /predict, GET /healthz, GET /stats)")
+                  f"({endpoints})")
             try:
                 httpd.serve_forever()
             except KeyboardInterrupt:

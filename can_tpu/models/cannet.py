@@ -335,15 +335,24 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
     The s0 floor / all-fill running-stats guard below are
     implementation-independent: every BNOps returns the same
     (mean, biased var, global valid count) contract.
+
+    Accumulator dtype: f32 is the FLOOR, not a ceiling — bf16/f32 inputs
+    take moments in f32 (the TPU contract), but f64 inputs keep f64.
+    Hard-pinning f32 here silently injected ~1e-7 reduction-order noise
+    into every BN layer of an x64 run, which backprop through the stacked
+    BN chain amplified to ~1e-1 at the earliest conv weights — exactly
+    the f32 noise floor the x64 parity worker (tests/bn_sp_x64_worker.py)
+    exists to escape, making its 1e-4 bound unreachable by construction.
     """
-    yf = y.astype(jnp.float32)
+    acc_dtype = jnp.float64 if y.dtype == jnp.float64 else jnp.float32
+    yf = y.astype(acc_dtype)
     if train:
         if bn_ops is None:
             from can_tpu.ops.bn_moments import BNOps
 
             bn_ops = BNOps()
         if mask is not None:
-            m = mask.astype(jnp.float32)  # (N, h, w, 1), matching y's NHW
+            m = mask.astype(acc_dtype)  # (N, h, w, 1), matching y's NHW
             # s0 floored at 1 (inside masked_moments): an all-fill batch
             # (every slot a dead remnant slot) has zero valid pixels, and
             # 0/0 moments would NaN the whole output — the floor yields
@@ -374,8 +383,8 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
         mean, var = stats["mean"], stats["var"]
         updated = None
     inv = jax.lax.rsqrt(var + eps)
-    out = (yf - mean) * inv * bn_params["scale"].astype(jnp.float32)
-    out = out + bn_params["bias"].astype(jnp.float32)
+    out = (yf - mean) * inv * bn_params["scale"].astype(acc_dtype)
+    out = out + bn_params["bias"].astype(acc_dtype)
     return out.astype(y.dtype), updated
 
 

@@ -58,10 +58,15 @@ import numpy as np
 # trace-event JSON by tools/trace_export.py).
 # tests/test_perf.py pins this tuple against the emit literals in the
 # tree — add the kind HERE when adding an emitter, or that test fails.
+# fleet.* kinds come from the serving fleet (can_tpu/serve/fleet.py):
+# fleet.replica is a replica state transition (quarantine on failure,
+# generation bump on rollout flip) and fleet.rollout is one completed
+# blue/green checkpoint rollout report.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
+               "fleet.replica", "fleet.rollout",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",
                "perf.summary", "trace.span")

@@ -37,7 +37,7 @@ _PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # the Prometheus ``_total`` suffix); the rest of the dict is gauges
 _SERVE_COUNTER_KEYS = frozenset(
     {"submitted", "completed", "rejected", "batches", "batch_slots",
-     "batch_valid", "compile_count"})
+     "batch_valid", "compile_count", "failures"})
 
 
 def _fmt_value(v) -> str:
@@ -55,28 +55,43 @@ def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
-def render_prometheus(gauges: Dict[str, float],
-                      counters: Dict[Tuple[str, tuple], float]) -> str:
-    """One exposition block: plain gauges, then labelled counters.
-    ``counters`` keys are ``(name, ((label, value), ...))``."""
+def _labelled_block(by_name: Dict[str, list], mtype: str) -> list:
     lines = []
-    for name in sorted(gauges):
-        v = gauges[name]
-        if v is None:
-            continue
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {_fmt_value(v)}")
-    by_name: Dict[str, list] = {}
-    for (name, labels), v in counters.items():
-        by_name.setdefault(name, []).append((labels, v))
     for name in sorted(by_name):
-        lines.append(f"# TYPE {name} counter")
+        lines.append(f"# TYPE {name} {mtype}")
         for labels, v in sorted(by_name[name], key=lambda kv: kv[0]):
             if labels:
                 lab = ",".join(f'{k}="{str(val)}"' for k, val in labels)
                 lines.append(f"{name}{{{lab}}} {_fmt_value(v)}")
             else:
                 lines.append(f"{name} {_fmt_value(v)}")
+    return lines
+
+
+def render_prometheus(gauges: Dict[str, float],
+                      counters: Dict[Tuple[str, tuple], float],
+                      labelled_gauges: Optional[
+                          Dict[Tuple[str, tuple], float]] = None) -> str:
+    """One exposition block: gauges, then counters.  Labelled maps key on
+    ``(name, ((label, value), ...))``.  A name appearing both plain and
+    labelled (the fleet's service-wide vs per-replica ``generation``)
+    renders as ONE group under ONE ``# TYPE`` line — the Prometheus text
+    parser rejects a second TYPE line for the same metric, and that would
+    void the whole scrape."""
+    by_name: Dict[str, list] = {}
+    for name in sorted(gauges):
+        v = gauges[name]
+        if v is None:
+            continue
+        by_name.setdefault(name, []).append(((), v))
+    if labelled_gauges:
+        for (name, labels), v in labelled_gauges.items():
+            by_name.setdefault(name, []).append((labels, v))
+    lines = _labelled_block(by_name, "gauge")
+    by_name = {}
+    for (name, labels), v in counters.items():
+        by_name.setdefault(name, []).append((labels, v))
+    lines += _labelled_block(by_name, "counter")
     return "\n".join(lines) + "\n" if lines else ""
 
 
@@ -160,6 +175,17 @@ class GaugeSink:
                             and not isinstance(v, bool) and v is not None:
                         self._gauges[f"{pre}_planner_{_sanitize(k)}"] = \
                             float(v)
+            elif kind == "fleet.rollout":
+                self._count((f"{pre}_fleet_rollouts_total", ()))
+                if "generation" in p:
+                    self._gauges[f"{pre}_fleet_generation"] = \
+                        float(p["generation"])
+            elif kind == "fleet.replica":
+                # state transitions: count quarantines per replica (flip
+                # events re-announce "active" and are not failures)
+                if str(p.get("state")) == "quarantined":
+                    self._count((f"{pre}_fleet_quarantines_total",
+                                 (("replica", str(p.get("replica", "?"))),)))
             elif kind == "perf.summary":
                 # performance-attribution aggregates (obs/costs.py
                 # ProgramCostLedger.summary): the payload keys are already
@@ -195,10 +221,29 @@ def render_stats(stats: dict, *, prefix: str = "can_tpu_serve",
                  counter_keys=_SERVE_COUNTER_KEYS) -> str:
     """Flat numeric stats dict -> Prometheus text (serve's ``/stats``
     counters in the same scrape).  Count-like keys get ``_total``; bools
-    become 0/1 gauges; Nones and nested values are skipped."""
+    become 0/1 gauges; Nones and other nested values are skipped — EXCEPT
+    the fleet's ``"replicas"`` sub-dicts, whose numeric entries become
+    per-replica LABELLED lines (``can_tpu_serve_batches_total{replica=
+    "k"}``), so one scrape shows which replica is serving, quarantined,
+    or lagging a rollout generation."""
     gauges: Dict[str, float] = {}
     counters: Dict[Tuple[str, tuple], float] = {}
+    labelled_gauges: Dict[Tuple[str, tuple], float] = {}
     for k, v in stats.items():
+        if k == "replicas" and isinstance(v, dict):
+            for rk, sub in v.items():
+                if not isinstance(sub, dict):
+                    continue
+                label = (("replica", str(rk)),)
+                for sk, sv in sub.items():
+                    if sv is None or not isinstance(sv, (int, float, bool)):
+                        continue
+                    name = f"{prefix}_{_sanitize(sk)}"
+                    if sk in counter_keys and not isinstance(sv, bool):
+                        counters[(f"{name}_total", label)] = sv
+                    else:  # quarantined/generation: state gauges
+                        labelled_gauges[(name, label)] = sv
+            continue
         if v is None or not isinstance(v, (int, float, bool)):
             continue
         name = f"{prefix}_{_sanitize(k)}"
@@ -206,7 +251,7 @@ def render_stats(stats: dict, *, prefix: str = "can_tpu_serve",
             counters[(f"{name}_total", ())] = v
         else:
             gauges[name] = v
-    return render_prometheus(gauges, counters)
+    return render_prometheus(gauges, counters, labelled_gauges)
 
 
 class MetricsExporter:

@@ -74,6 +74,10 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_queue_depth_max = None
     perf_last: Optional[dict] = None
     span_names: dict = {}
+    fleet_rollouts = 0
+    fleet_generation = None
+    fleet_quarantines: dict = {}
+    fleet_states: dict = {}
     cache_last: Optional[dict] = None
     planner_last: Optional[dict] = None
     prepared_splits: dict = {}
@@ -147,6 +151,17 @@ def summarize(events: Iterable[dict]) -> dict:
             split = str(p.get("split", "?"))
             prepared_splits[split] = ("on" if p.get("active")
                                       else f"legacy({p.get('reason', '?')})")
+        elif kind == "fleet.rollout":
+            fleet_rollouts += 1
+            if p.get("generation") is not None:
+                g = int(p["generation"])
+                fleet_generation = (g if fleet_generation is None
+                                    else max(fleet_generation, g))
+        elif kind == "fleet.replica":
+            rk = str(p.get("replica", "?"))
+            fleet_states[rk] = str(p.get("state", "?"))  # last state wins
+            if p.get("state") == "quarantined":
+                fleet_quarantines[rk] = fleet_quarantines.get(rk, 0) + 1
         elif kind == "perf.summary":
             perf_last = p  # the ledger is cumulative: the last wins
         elif kind == "trace.span":
@@ -186,6 +201,11 @@ def summarize(events: Iterable[dict]) -> dict:
         "serve_queue_wait_p50_s": _percentile(serve_queue_wait, 50),
         "serve_queue_wait_p95_s": _percentile(serve_queue_wait, 95),
         "serve_device_p95_s": _percentile(serve_device, 95),
+        # serving fleet (can_tpu/serve/fleet.py); zeros/empty single-engine
+        "fleet_rollouts": fleet_rollouts,
+        "fleet_generation": fleet_generation,
+        "fleet_quarantines": sum(fleet_quarantines.values()),
+        "fleet_replica_states": dict(sorted(fleet_states.items())),
         # host data pipeline (can_tpu/data/prepared.py); Nones/empty offline
         "prepared_splits": dict(sorted(prepared_splits.items())),
         "cache_hits": cache_last.get("hits") if cache_last else None,
@@ -344,6 +364,17 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                 ("serve breakdown",
                  f"queue_wait p95={_fmt(summary['serve_queue_wait_p95_s'])} s"
                  f" device p95={_fmt(summary['serve_device_p95_s'])} s"))
+    if (summary.get("fleet_rollouts") or summary.get("fleet_quarantines")
+            or summary.get("fleet_replica_states")):
+        states = summary.get("fleet_replica_states") or {}
+        rows.append(
+            ("serving fleet",
+             f"rollouts={summary['fleet_rollouts']} "
+             f"generation={_fmt(summary.get('fleet_generation'))} "
+             f"quarantines={summary['fleet_quarantines']}"
+             + ((" replicas: "
+                 + " ".join(f"r{k}={v}" for k, v in states.items()))
+                if states else "")))
     width = max(len(k) for k, _ in rows)
     lines = [f"# {title}"]
     lines += [f"{k.ljust(width)}  {v}" for k, v in rows]

@@ -24,7 +24,20 @@ computes offline for the same image and params.
 """
 
 from .batcher import MicroBatcher
-from .engine import ServeEngine
+from .engine import ServeEngine, tree_signature
+from .fleet import (
+    REPLICA_ACTIVE,
+    REPLICA_QUARANTINED,
+    FleetClosedError,
+    FleetEngine,
+)
+from .quant import (
+    PARITY_LADDER,
+    SERVE_DTYPES,
+    dequantize_tree,
+    parity_report,
+    quantize_tree,
+)
 from .queue import (
     REJECT_BACKPRESSURE,
     REJECT_DEADLINE,
@@ -47,7 +60,17 @@ from .service import (
 __all__ = [
     "BoundedRequestQueue",
     "CountService",
+    "FleetClosedError",
+    "FleetEngine",
     "MicroBatcher",
+    "PARITY_LADDER",
+    "REPLICA_ACTIVE",
+    "REPLICA_QUARANTINED",
+    "SERVE_DTYPES",
+    "dequantize_tree",
+    "parity_report",
+    "quantize_tree",
+    "tree_signature",
     "REJECT_BACKPRESSURE",
     "REJECT_DEADLINE",
     "REJECT_ERROR",

@@ -8,11 +8,21 @@ have produced for the same image and params (the offline/online parity the
 tests pin).  The engine adds only what serving needs around that math:
 
 * params (and BN ``batch_stats``) are device-resident from construction —
-  a host-numpy param tree fed to jit would re-upload ~74 MB per batch;
+  a host-numpy param tree fed to jit would re-upload ~74 MB per batch —
+  and stored in the ``serve_dtype`` format (``serve/quant.py``): f32
+  bit-parity, bf16 MXU-rate, or int8 weight-only PTQ with in-program
+  dequantization and f32 accumulation;
+* ``device=`` pins one engine to one device of the mesh (the fleet's
+  replica placement: committed params make jit place the whole program
+  on that device) — None keeps the single-device default behaviour;
 * ``warmup()`` drives one zero batch through every bucket shape BEFORE
   traffic, so no real request pays the multi-second trace+compile bill,
   and ``utils/compile_cache`` (wired by the CLI) makes warm restarts
   deserialise instead of recompile;
+* ``swap_params()`` atomically replaces the device-resident trees with a
+  new checkpoint's — same structure means the already-compiled programs
+  serve the new weights instantly (params are jit ARGUMENTS, not
+  constants), which is what makes the fleet's blue/green flip free;
 * every new (shape, dtype) signature is counted and attributed on the
   telemetry bus via ``obs.RecompileTracker`` — a mid-traffic compile is a
   latency cliff an operator must be able to see.
@@ -30,6 +40,11 @@ import numpy as np
 from can_tpu.data.batching import Batch, pad_batch
 from can_tpu.models import cannet_apply
 from can_tpu.obs import RecompileTracker, Telemetry
+from can_tpu.serve.quant import (
+    compute_dtype_for,
+    dequantize_tree,
+    quantize_tree,
+)
 from can_tpu.train.loss import density_counts
 from can_tpu.train.steps import _batch_image
 
@@ -40,25 +55,56 @@ def _batch_dict(batch: Batch) -> dict:
             "sample_mask": batch.sample_mask}
 
 
+def tree_signature(tree) -> tuple:
+    """Structure + per-leaf (shape, dtype) of a pytree — the compiled
+    predict programs' view of the params.  Two trees with equal
+    signatures are interchangeable WITHOUT recompilation; a rollout to a
+    differently-shaped checkpoint must be refused, not compiled mid-
+    traffic."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),
+            tuple((tuple(x.shape), str(jnp.asarray(x).dtype))
+                  for x in leaves))
+
+
 class ServeEngine:
-    """Executes padded serve batches on the local device.
+    """Executes padded serve batches on one device.
 
     params / batch_stats: as returned by ``cli.test.load_params`` (host or
     device trees; moved on-device once here).
-    compute_dtype: jnp.bfloat16 for MXU-rate serving, None for f32 parity.
+    serve_dtype: "f32" | "bf16" | "int8" — the storage/compute mode
+    (serve/quant.py); "f32" is the bit-parity default.
+    compute_dtype: overrides the mode's compute dtype (the legacy --bf16
+    path: f32 params, bf16 compute).  None derives it from serve_dtype.
+    device: pin params (and hence the compiled programs) to this device.
+    quantized: params/batch_stats are ALREADY in serve_dtype storage form
+    (the fleet quantizes once and replicates, instead of per replica).
     telemetry: optional bus for ``compile`` events; the engine works (and
     still counts compiles) without one.
     """
 
     def __init__(self, params, batch_stats=None, *, compute_dtype=None,
-                 ds: int = 8, telemetry=None):
+                 serve_dtype: str = "f32", ds: int = 8, device=None,
+                 quantized: bool = False, telemetry=None,
+                 name: str = "serve_predict"):
         self.ds = int(ds)
-        self.params = jax.device_put(params)
+        self.serve_dtype = serve_dtype
+        self.device = device
+        self.name = name
+        if not quantized:
+            params = quantize_tree(params, serve_dtype)
+        self.params = self._put(params)
         self.batch_stats = (None if batch_stats is None
-                            else jax.device_put(batch_stats))
+                            else self._put(batch_stats))
+        self._signature = tree_signature((self.params, self.batch_stats))
         self.telemetry = telemetry if telemetry is not None else Telemetry()
+        if compute_dtype is None:
+            compute_dtype = compute_dtype_for(serve_dtype)
 
         def predict(params, batch, batch_stats):
+            # int8 mode: in-program dequant (fused multiply; HBM holds
+            # int8) -> f32 weights -> f32 arithmetic ("f32 accumulation")
+            params = dequantize_tree(params, serve_dtype)
             image = _batch_image(batch)  # u8 -> normalised f32, f32 passthru
             if batch_stats is not None:
                 pred = cannet_apply(params, image,
@@ -76,13 +122,42 @@ class ServeEngine:
         # bucket warmup and any mid-traffic compile both land as `compile`
         # events, and len(signatures) is the engine's compile count
         self._predict = RecompileTracker(jax.jit(predict), self.telemetry,
-                                         name="serve_predict", batch_arg=1)
-        self._signatures = self.telemetry.signature_registry["serve_predict"]
+                                         name=name, batch_arg=1)
+        self._signatures = self.telemetry.signature_registry[name]
+
+    def _put(self, tree):
+        if self.device is None:
+            return jax.device_put(tree)
+        return jax.device_put(tree, self.device)
 
     @property
     def compile_count(self) -> int:
         """Distinct predict signatures compiled so far."""
         return len(self._signatures)
+
+    def swap_params(self, params, batch_stats=None, *,
+                    quantized: bool = False) -> None:
+        """Atomically replace the served weights (the blue/green flip).
+
+        The new trees must match the current param signature exactly —
+        same structure, shapes, dtypes — so every already-compiled bucket
+        program serves the new weights with ZERO recompilation.  A
+        mismatch raises instead of silently queueing a mid-traffic
+        compile.  The caller serialises against in-flight ``predict_batch``
+        calls (the fleet holds the replica's dispatch lock)."""
+        if not quantized:
+            params = quantize_tree(params, self.serve_dtype)
+        params = self._put(params)
+        batch_stats = None if batch_stats is None else self._put(batch_stats)
+        sig = tree_signature((params, batch_stats))
+        if sig != self._signature:
+            raise ValueError(
+                "swap_params structure mismatch: the new checkpoint's "
+                "param tree differs in structure/shape/dtype from the "
+                "serving tree — flipping would recompile every bucket "
+                "program mid-traffic; deploy it as a fresh fleet instead")
+        self.params = params
+        self.batch_stats = batch_stats
 
     def predict_batch(self, batch: Batch, *, want_density: bool = False
                       ) -> Tuple[np.ndarray, Optional[np.ndarray]]:

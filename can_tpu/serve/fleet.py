@@ -1,0 +1,491 @@
+"""FleetEngine: N replica ServeEngines behind one work-stealing dispatcher.
+
+The single ``ServeEngine`` serves one device; the ROADMAP's "millions of
+users" target needs every device of the mesh serving, a way to ship a new
+checkpoint without dropping traffic, and graceful degradation when a
+replica dies.  This module is that fleet layer:
+
+* **Placement** — params are quantized ONCE (``serve/quant.py``), pushed
+  to every replica device in one batched transfer via a replicated
+  ``NamedSharding`` over a 1-D ``("replica",)`` mesh (the SNIPPETS [2]
+  ``get_replicated_sharding`` pattern), then committed per replica with a
+  single-device ``device_put`` (free: the bytes are already resident).
+  Each replica is a full ``ServeEngine`` pinned to its device — committed
+  params make jit place that replica's programs on that device.
+
+* **Work stealing** — one shared FIFO of assembled micro-batches; every
+  idle replica thread pulls the next item.  No per-replica queues, no
+  assignment policy, therefore no starvation: a replica is only ever idle
+  when the queue is empty.  The MicroBatcher keeps its single assembly
+  thread; ``CountService`` routes its dispatch here instead of executing
+  inline, so assembly and N executions overlap.
+
+* **Failure containment** — a replica whose predict raises is QUARANTINED
+  (removed from dispatch, state exported on ``/healthz`` and as a
+  ``fleet.replica`` event); its in-flight batch is re-dispatched exactly
+  once to a healthy replica.  A batch that fails on a SECOND replica is
+  rejected with ``error`` and that replica stays in service (poison
+  input, not a dead replica — one bad batch must not take the whole
+  fleet down).  When the last replica quarantines, queued work is
+  failed instead of hanging.
+
+* **Blue/green rollout** — ``rollout(params, ...)`` ships a new
+  checkpoint with zero rejected or dropped requests: config drift guard
+  (PR-3's ``check_resume_config`` on the serve-relevant keys), then a
+  STAGING engine on the last replica's device warms every (bucket, dtype)
+  program with the new weights while live traffic continues, then each
+  replica is flipped one at a time under its dispatch lock via
+  ``ServeEngine.swap_params`` — params are jit arguments, so a
+  same-signature tree swap reuses every compiled program with zero
+  recompilation, and at most one replica is briefly paused while the
+  others keep pulling work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from can_tpu.obs import Telemetry
+from can_tpu.serve.engine import ServeEngine, tree_signature
+from can_tpu.serve.quant import quantize_tree
+
+REPLICA_ACTIVE = "active"
+REPLICA_QUARANTINED = "quarantined"
+
+
+class FleetClosedError(RuntimeError):
+    """Work submitted after the fleet shut down."""
+
+
+class _WorkItem:
+    __slots__ = ("bucket_hw", "batch", "requests", "redispatches")
+
+    def __init__(self, bucket_hw, batch, requests):
+        self.bucket_hw = bucket_hw
+        self.batch = batch
+        self.requests = requests
+        self.redispatches = 0
+
+
+class ReplicaState:
+    """One replica: engine + device + dispatch lock + health."""
+
+    def __init__(self, index: int, device, engine: ServeEngine):
+        self.index = index
+        self.device = device
+        self.engine = engine
+        # held for the duration of each predict AND for a rollout flip —
+        # swap_params never races an in-flight batch
+        self.lock = threading.Lock()
+        self.state = REPLICA_ACTIVE
+        self.batches = 0
+        self.failures = 0
+        self.error: Optional[str] = None
+        self.generation = 0
+
+    def snapshot(self) -> dict:
+        return {"replica": self.index, "device": str(self.device),
+                "state": self.state, "batches": self.batches,
+                "failures": self.failures, "error": self.error,
+                "generation": self.generation}
+
+
+def _replicate(tree, devices):
+    """One batched host->devices transfer: every leaf fully replicated
+    over a 1-D replica mesh (NamedSharding with an empty PartitionSpec)."""
+    mesh = Mesh(np.asarray(devices), ("replica",))
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.device_put(tree, sharding)
+
+
+def _per_device(tree, device):
+    """Commit a replicated tree to one device (the bytes are already
+    there; this just re-keys the arrays to a single-device sharding)."""
+    return jax.tree.map(lambda x: jax.device_put(x, device), tree)
+
+
+class FleetEngine:
+    """N device-pinned replica engines + the shared work queue.
+
+    params / batch_stats: f32 trees (host or device).  serve_dtype picks
+    the storage/compute mode for EVERY replica (serve/quant.py).
+    replicas: engine count; devices (default ``jax.devices()``) supplies
+    the distinct devices, one per replica.
+    run_config: the checkpoint's saved run config (utils/checkpoint.py
+    ``load_run_config``), kept for the rollout drift guard; None skips
+    the config check on rollout (pre-guard checkpoints).
+    """
+
+    def __init__(self, params, batch_stats=None, *, replicas: int = 2,
+                 serve_dtype: str = "f32", compute_dtype=None, ds: int = 8,
+                 devices: Optional[Sequence] = None, telemetry=None,
+                 run_config: Optional[dict] = None,
+                 name: str = "serve_predict"):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        devices = list(devices if devices is not None else jax.devices())
+        if replicas > len(devices):
+            raise ValueError(
+                f"replicas={replicas} exceeds the {len(devices)} available "
+                f"devices — a replica without its own device just time-"
+                f"slices another's, add chips or lower --replicas")
+        self.ds = int(ds)
+        self.serve_dtype = serve_dtype
+        self._compute_dtype = compute_dtype
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.run_config = run_config
+        self.name = name
+        self.generation = 0
+        self.devices = devices[:replicas]
+
+        qparams = quantize_tree(params, serve_dtype)
+        rep_params = _replicate(qparams, self.devices)
+        rep_stats = (None if batch_stats is None
+                     else _replicate(batch_stats, self.devices))
+        self.replicas: List[ReplicaState] = []
+        for k, dev in enumerate(self.devices):
+            engine = ServeEngine(
+                _per_device(rep_params, dev),
+                None if rep_stats is None else _per_device(rep_stats, dev),
+                serve_dtype=serve_dtype, compute_dtype=compute_dtype,
+                ds=ds, device=dev, quantized=True, telemetry=self.telemetry,
+                name=f"{name}_r{k}")
+            self.replicas.append(ReplicaState(k, dev, engine))
+
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._swept = False  # close()'s leftover sweep has run
+        self._started = False
+        self._threads: List[threading.Thread] = []
+        self._rollout_lock = threading.Lock()
+        self._warmup_spec: Optional[Tuple] = None
+        # bound by CountService: completion/failure sinks for executed work
+        self._on_complete: Optional[Callable] = None
+        self._on_fail: Optional[Callable] = None
+        self._on_reject: Optional[Callable] = None
+        # deadline checks must read the SAME clock that stamped
+        # deadline_ts (the service's, injectable for fake-clock tests)
+        self._clock = time.monotonic
+
+    # -- service binding --------------------------------------------------
+    def bind(self, *, on_complete: Callable, on_fail: Callable,
+             on_reject: Optional[Callable] = None, clock=None) -> None:
+        """``on_complete(bucket_hw, batch, requests, counts, density,
+        execute_s, compiled, replica, program)`` after a successful batch;
+        ``on_fail(requests, exc)`` after a twice-failed one;
+        ``on_reject(reason, count)`` counts rejections the fleet already
+        emitted telemetry for (zombie-batch shedding)."""
+        self._on_complete = on_complete
+        self._on_fail = on_fail
+        self._on_reject = on_reject
+        if clock is not None:
+            self._clock = clock
+
+    # -- engine-compatible surface ---------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Distinct predict signatures across live+quarantined replicas
+        (staging engines bill to their own per-generation registry)."""
+        return sum(r.engine.compile_count for r in self.replicas)
+
+    def live_replicas(self) -> int:
+        return sum(1 for r in self.replicas if r.state == REPLICA_ACTIVE)
+
+    def warmup(self, bucket_shapes, max_batch: int, *,
+               dtypes=(np.float32,)) -> dict:
+        """Warm EVERY replica's full (bucket, dtype) program grid — the
+        per-replica jit caches are independent, so each pays its own
+        compiles here and none during traffic.  The spec is remembered:
+        rollout's staging warmup re-runs exactly this grid."""
+        self._warmup_spec = (sorted(set(map(tuple, bucket_shapes))),
+                             int(max_batch), tuple(dtypes))
+        t0 = time.perf_counter()
+        shapes = compiles = 0
+        for r in self.replicas:
+            with r.lock:
+                rep = r.engine.warmup(bucket_shapes, max_batch,
+                                      dtypes=dtypes)
+            shapes = rep["shapes"]
+            compiles += rep["compiles"]
+        return {"shapes": shapes, "compiles": compiles,
+                "replicas": len(self.replicas),
+                "seconds": round(time.perf_counter() - t0, 3)}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "FleetEngine":
+        if self._started:
+            return self
+        self._started = True
+        for r in self.replicas:
+            t = threading.Thread(target=self._worker, args=(r,),
+                                 daemon=True,
+                                 name=f"can-tpu-fleet-r{r.index}")
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def close(self, *, drain_timeout_s: float = 60.0) -> None:
+        """Drain queued work through the replicas, then stop the threads.
+        Anything still queued when no live replica remains (or the drain
+        times out) is failed, never silently dropped."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        deadline = time.monotonic() + drain_timeout_s
+        for t in self._threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        self._threads = []
+        leftovers = []
+        with self._cond:
+            self._swept = True
+            while self._queue:
+                leftovers.append(self._queue.popleft())
+        for item in leftovers:
+            self._fail(item, FleetClosedError("fleet closed with work "
+                                              "still queued"))
+
+    # -- dispatch ---------------------------------------------------------
+    def submit_work(self, bucket_hw, batch, requests) -> None:
+        """Called by the service's dispatch (the batcher thread): enqueue
+        one assembled micro-batch for whichever replica frees up first."""
+        item = _WorkItem(bucket_hw, batch, requests)
+        with self._cond:
+            if not self._closed and self.live_replicas() > 0:
+                self._queue.append(item)
+                self._cond.notify()
+                return
+            closed = self._closed
+        self._fail(item, FleetClosedError(
+            "fleet closed" if closed else "no live replicas"))
+
+    def _take(self, replica: ReplicaState) -> Optional[_WorkItem]:
+        with self._cond:
+            while True:
+                if replica.state != REPLICA_ACTIVE:
+                    return None
+                if self._queue:
+                    return self._queue.popleft()
+                if self._closed:
+                    return None
+                self._cond.wait(0.1)
+
+    def _worker(self, replica: ReplicaState) -> None:
+        while True:
+            item = self._take(replica)
+            if item is None:
+                return
+            # zombie-batch shed: a batch whose EVERY request has already
+            # expired (deadline passed while it sat behind the work
+            # queue) would burn a full device launch producing results
+            # nobody is waiting for — reject instead of execute.  A batch
+            # with ANY live request still runs whole: slots are padded,
+            # and the live results are the point.
+            now = self._clock()
+            if all(r.done or r.expired(now) for r in item.requests):
+                from can_tpu.serve.queue import REJECT_DEADLINE
+
+                n = 0
+                for r in item.requests:
+                    if not r.done:
+                        r.reject(REJECT_DEADLINE,
+                                 "expired behind the fleet work queue")
+                        n += 1
+                if n:
+                    self.telemetry.emit("serve.reject",
+                                        reason=REJECT_DEADLINE, count=n)
+                    if self._on_reject is not None:
+                        self._on_reject(REJECT_DEADLINE, n)
+                continue
+            t0 = time.perf_counter()
+            try:
+                with replica.lock:
+                    want = any(r.want_density for r in item.requests)
+                    counts, density = replica.engine.predict_batch(
+                        item.batch, want_density=want)
+                    compiled = replica.engine.last_batch_compiled
+                    replica.batches += 1
+            except Exception as e:  # noqa: BLE001 — replica failure path
+                self._quarantine(replica, item, e)
+                continue
+            execute_s = time.perf_counter() - t0
+            if self._on_complete is not None:
+                self._on_complete(item.bucket_hw, item.batch, item.requests,
+                                  counts, density, execute_s, compiled,
+                                  replica.index, replica.engine.name)
+
+    def _quarantine(self, replica: ReplicaState, item: _WorkItem,
+                    exc: Exception) -> None:
+        replica.failures += 1
+        item.redispatches += 1
+        if item.redispatches > 1:
+            # failed on a SECOND distinct replica (the first was
+            # quarantined before the re-dispatch): the batch is the
+            # poison, not the fleet — reject it and keep this replica
+            # serving.  One bad input must not cascade into
+            # quarantining every replica it touches.
+            self.telemetry.emit("fleet.replica", **replica.snapshot())
+            self._fail(item, exc)
+            return
+        replica.state = REPLICA_QUARANTINED
+        replica.error = f"{type(exc).__name__}: {exc}"
+        self.telemetry.emit("fleet.replica", **replica.snapshot())
+        stranded = [item]
+        with self._cond:
+            if self.live_replicas() > 0 and not self._swept:
+                # front of the queue: its requests have waited longest.
+                # Deliberately ALSO while close() drains: the remaining
+                # live workers still pull, and anything they don't reach
+                # is failed by close()'s leftover sweep — rejecting here
+                # would drop a request a live replica would have served.
+                # (_swept guards the post-sweep stragglers of a timed-out
+                # drain, the one window where a requeue could strand.)
+                self._queue.appendleft(item)
+                self._cond.notify()
+                return
+            if self.live_replicas() == 0:
+                # the LAST live replica just died: no worker remains to
+                # drain the queue, so everything queued is failed too —
+                # never stranded behind a fleet with no executors
+                while self._queue:
+                    stranded.append(self._queue.popleft())
+        for it in stranded:
+            self._fail(it, exc)
+
+    def _fail(self, item: _WorkItem, exc: Exception) -> None:
+        if self._on_fail is not None:
+            self._on_fail(item.requests, exc)
+        else:  # unbound fleet (direct tests): reject inline
+            from can_tpu.serve.queue import REJECT_ERROR
+
+            for r in item.requests:
+                if not r.done:
+                    r.reject(REJECT_ERROR, f"{type(exc).__name__}: {exc}")
+
+    # -- health -----------------------------------------------------------
+    def healthz(self) -> dict:
+        live = self.live_replicas()
+        return {"ok": live > 0, "replicas": [r.snapshot()
+                                             for r in self.replicas],
+                "live": live, "generation": self.generation,
+                "serve_dtype": self.serve_dtype,
+                "queue_depth": len(self._queue)}
+
+    # -- blue/green rollout ----------------------------------------------
+    def rollout(self, params, batch_stats=None, *,
+                run_config: Optional[dict] = None,
+                allow_config_change: bool = False) -> dict:
+        """Ship a new checkpoint into the serving fleet with zero dropped
+        requests.  Synchronous — call it from a background thread (the
+        HTTP /rollout handler does); traffic keeps flowing on every
+        replica not currently mid-flip.  Returns the rollout report."""
+        with self._rollout_lock:
+            t0 = time.perf_counter()
+            gen = self.generation + 1
+            spans = getattr(self.telemetry, "spans", None)
+            trace_id = (spans.new_trace_id(f"rollout-g{gen}")
+                        if spans is not None else None)
+
+            # 1. free guards first — a refused rollout does no device
+            #    work: the staging grid must exist, and a checkpoint
+            #    trained as a different model VARIANT must be refused
+            if self._warmup_spec is None:
+                raise RuntimeError("rollout before warmup(): the fleet "
+                                   "has no (bucket, dtype) grid to stage")
+            drifted: List[str] = []
+            if run_config is not None and self.run_config is not None:
+                from can_tpu.utils.checkpoint import check_serve_config
+
+                drifted = check_serve_config(self.run_config, run_config,
+                                             allow=allow_config_change)
+
+            # 2. quantize once, replicate once (same path as __init__)
+            qparams = quantize_tree(params, self.serve_dtype)
+            rep_params = _replicate(qparams, self.devices)
+            rep_stats = (None if batch_stats is None
+                         else _replicate(batch_stats, self.devices))
+
+            # 3. structural guard BEFORE staging: a tree that would change
+            #    the jit signature would recompile mid-traffic on flip
+            ref = self.replicas[0].engine
+            stage_dev = self.devices[-1]
+            new_sig = tree_signature((
+                _per_device(rep_params, stage_dev),
+                None if rep_stats is None
+                else _per_device(rep_stats, stage_dev)))
+            old_sig = tree_signature((ref.params, ref.batch_stats))
+            if new_sig != old_sig:
+                raise ValueError(
+                    "rollout refused: the new checkpoint's param tree "
+                    "differs in structure/shape/dtype from the serving "
+                    "tree (did the model variant change?) — deploy it as "
+                    "a fresh fleet instead of a hot flip")
+
+            # 4. staging warmup in the background of live traffic: every
+            #    (bucket, dtype) program runs the NEW weights end-to-end
+            #    on the staging device before any live replica flips —
+            #    catches NaN checkpoints and numeric blowups off-path
+            shapes, max_batch, dtypes = self._warmup_spec
+            t_stage0 = time.perf_counter()
+            staging = ServeEngine(
+                _per_device(rep_params, stage_dev),
+                None if rep_stats is None
+                else _per_device(rep_stats, stage_dev),
+                serve_dtype=self.serve_dtype,
+                compute_dtype=self._compute_dtype, ds=self.ds,
+                device=stage_dev, quantized=True, telemetry=self.telemetry,
+                name=f"{self.name}_staging_g{gen}")
+            stage_report = staging.warmup(shapes, max_batch, dtypes=dtypes)
+            t_stage1 = time.perf_counter()
+            if spans is not None:
+                spans.emit(trace_id=trace_id, name="rollout.staging",
+                           start=t_stage0, end=t_stage1,
+                           compiles=stage_report["compiles"])
+
+            # 5. flip one replica at a time under its dispatch lock: the
+            #    other replicas keep pulling from the shared queue, so no
+            #    request is rejected or dropped while any replica flips
+            flipped = []
+            for r in self.replicas:
+                if r.state != REPLICA_ACTIVE:
+                    continue  # quarantined replicas stay on the old gen
+                t_f0 = time.perf_counter()
+                with r.lock:
+                    r.engine.swap_params(
+                        _per_device(rep_params, r.device),
+                        None if rep_stats is None
+                        else _per_device(rep_stats, r.device),
+                        quantized=True)
+                    r.generation = gen
+                flipped.append(r.index)
+                self.telemetry.emit("fleet.replica", **r.snapshot())
+                if spans is not None:
+                    spans.emit(trace_id=trace_id,
+                               name=f"rollout.flip_r{r.index}",
+                               start=t_f0, end=time.perf_counter())
+
+            self.generation = gen
+            if run_config is not None:
+                self.run_config = run_config
+            report = {"generation": gen, "flipped": flipped,
+                      "skipped": [r.index for r in self.replicas
+                                  if r.index not in flipped],
+                      "staging_compiles": stage_report["compiles"],
+                      "staging_seconds": stage_report["seconds"],
+                      "config_drift": drifted,
+                      "seconds": round(time.perf_counter() - t0, 3)}
+            self.telemetry.emit("fleet.rollout", **report)
+            if spans is not None:
+                spans.emit(trace_id=trace_id, name="rollout",
+                           start=t0, end=time.perf_counter(),
+                           generation=gen)
+            return report

@@ -11,16 +11,26 @@ full stack through it with zero networking.  The HTTP front end
 (``serve_http``) is a thin stdlib adapter over the same calls: one process,
 one device owner, many client connections.
 
+The engine may be a single ``ServeEngine`` (dispatch executes inline on
+the batcher thread, the original topology) or a ``FleetEngine``
+(serve/fleet.py): then dispatch ENQUEUES the assembled batch and returns,
+replica worker threads execute on their own devices and call back into
+``_complete`` — same resolution/telemetry code either way, so every
+guarantee (typed rejection, parity, bounded compiles) holds per replica.
+
 Telemetry (same bus/schema as train/eval, summarised by
 ``tools/telemetry_report.py``):
 
 * ``serve.request``  — per completed request: latency_s, bucket, ok
 * ``serve.batch``    — per flush: bucket, size/valid/fill, execute_s,
                        queue_depth (the depth gauge rides the batch event:
-                       sampled exactly when it changes, no extra thread)
+                       sampled exactly when it changes, no extra thread);
+                       fleet batches add ``replica``
 * ``serve.reject``   — per rejection: reason (queue_full / backpressure /
                        deadline / shutdown / error)
 * ``serve.warmup``   — pre-traffic compile pass summary
+* ``fleet.replica`` / ``fleet.rollout`` — emitted by serve/fleet.py:
+                       replica state transitions and rollout reports
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ from can_tpu.data.dataset import normalize_host
 from can_tpu.serve.batcher import MicroBatcher
 from can_tpu.serve.engine import ServeEngine
 from can_tpu.serve.queue import (
+    REJECT_ERROR,
     REJECT_SHUTDOWN,
     BoundedRequestQueue,
     RejectedError,
@@ -120,6 +131,14 @@ class CountService:
                  telemetry=None, clock=time.monotonic,
                  perf_summary_every: int = 32):
         self.engine = engine
+        # fleet mode: dispatch enqueues instead of executing inline, and
+        # replica workers call _complete/_fail_batch back on this service
+        self._fleet = engine if hasattr(engine, "submit_work") else None
+        if self._fleet is not None:
+            self._fleet.bind(on_complete=self._complete,
+                             on_fail=self._fail_batch,
+                             on_reject=self._note_reject, clock=clock)
+        self._replica_stats: dict = {}
         self.telemetry = telemetry if telemetry is not None else engine.telemetry
         self.max_batch = int(max_batch)
         self.default_deadline_s = (None if default_deadline_ms is None
@@ -184,6 +203,8 @@ class CountService:
 
     def start(self) -> "CountService":
         if not self._started:
+            if self._fleet is not None:
+                self._fleet.start()
             self.batcher.start()
             self._started = True
         return self
@@ -197,6 +218,10 @@ class CountService:
             r.reject(REJECT_SHUTDOWN, "service closing")
             self._count_reject(REJECT_SHUTDOWN)
         self.batcher.close()  # flushes pending groups through the engine
+        if self._fleet is not None:
+            # after the batcher: its shutdown flush enqueues final work,
+            # which the replicas drain before their threads stop
+            self._fleet.close()
         ledger = getattr(self.telemetry, "ledger", None)
         if ledger is not None:
             ledger.emit_summary(self.telemetry, phase="serve_close")
@@ -260,8 +285,9 @@ class CountService:
         with self._lock:
             s = dict(self._stats)
             lat = self.latency.percentiles()
+            rep_counts = {k: dict(v) for k, v in self._replica_stats.items()}
         slots = max(s["batch_slots"], 1)
-        return {
+        out = {
             **s,
             "queue_depth": self.queue.depth(),
             "shedding": self.queue.shedding,
@@ -271,9 +297,30 @@ class CountService:
             "latency_max_s": lat["max_s"],
             "compile_count": self.engine.compile_count,
         }
+        if self._fleet is not None:
+            # per-replica rows: service-side work counters joined with the
+            # fleet's health snapshot — obs/exporter.py renders these as
+            # can_tpu_serve_*{replica="k"} labelled lines
+            health = {r["replica"]: r
+                      for r in self._fleet.healthz()["replicas"]}
+            out["replicas"] = {
+                str(k): {**rep_counts.get(k, {"batches": 0,
+                                              "completed": 0}),
+                         "quarantined": int(h["state"] != "active"),
+                         "failures": h["failures"],
+                         "generation": h["generation"]}
+                for k, h in health.items()}
+            out["live_replicas"] = self._fleet.live_replicas()
+            out["generation"] = self._fleet.generation
+        return out
 
     # -- batcher dispatch (runs on the batcher thread) -------------------
     def _dispatch(self, bucket_hw, batch, requests) -> None:
+        if self._fleet is not None:
+            # hand the assembled batch to whichever replica frees up
+            # first; the worker thread calls _complete (or _fail_batch)
+            self._fleet.submit_work(bucket_hw, batch, requests)
+            return
         t_exec0 = self._clock()
         t0 = time.perf_counter()
         counts, density = self.engine.predict_batch(
@@ -282,8 +329,21 @@ class CountService:
         # the fake clocks the tests drive); the CLOCK stamps below anchor
         # the spans in the same timeline as t_submit/deadlines
         execute_s = time.perf_counter() - t0
-        t_exec1 = self._clock()
         compiled = self.engine.last_batch_compiled
+        self._complete(bucket_hw, batch, requests, counts, density,
+                       execute_s, compiled, t_exec0=t_exec0)
+
+    # -- batch completion (batcher thread, or a fleet replica worker) ----
+    def _complete(self, bucket_hw, batch, requests, counts, density,
+                  execute_s, compiled, replica=None,
+                  program: str = "serve_predict", t_exec0=None) -> None:
+        t_exec1 = self._clock()
+        if t_exec0 is None:
+            # fleet path: the worker measured execute_s on perf_counter;
+            # anchor the device span by subtracting it on the service
+            # clock (exact for the default monotonic clock, and merely a
+            # display anchor under test fake clocks)
+            t_exec0 = t_exec1 - execute_s
         fill = len(requests) / batch.image.shape[0]
         now = self._clock()
         spans = getattr(self.telemetry, "spans", None)
@@ -343,19 +403,26 @@ class CountService:
             self._stats["batches"] += 1
             self._stats["batch_slots"] += batch.image.shape[0]
             self._stats["batch_valid"] += len(requests)
+            if replica is not None:
+                rs = self._replica_stats.setdefault(
+                    replica, {"batches": 0, "completed": 0})
+                rs["batches"] += 1
+                rs["completed"] += len(requests)
+        extra = {} if replica is None else {"replica": replica}
         self.telemetry.emit("serve.batch", bucket=list(bucket_hw),
                            size=batch.image.shape[0], valid=len(requests),
                            fill=round(fill, 4),
                            execute_s=round(execute_s, 6),
                            compiled=compiled,
-                           queue_depth=self.queue.depth())
+                           queue_depth=self.queue.depth(), **extra)
         ledger = getattr(self.telemetry, "ledger", None)
         if ledger is not None:
             if not compiled:
                 # steady-state launch time for this program (first-call
                 # compiles are the compile event's bill, same exclusion
-                # rule as the step reservoirs)
-                ledger.observe("serve_predict", tuple(batch.image.shape),
+                # rule as the step reservoirs); fleet batches bill their
+                # replica's own program name
+                ledger.observe(program, tuple(batch.image.shape),
                                execute_s, dtype=str(batch.image.dtype))
             self._perf_batches += 1
             if 0 < self.perf_summary_every <= self._perf_batches:
@@ -374,6 +441,42 @@ class CountService:
         self.telemetry.emit("serve.reject", reason=reason, count=1,
                            queue_depth=self.queue.depth())
 
+    def _fail_batch(self, requests, exc) -> None:
+        """Fleet failure sink: a batch that failed on two replicas (or
+        outlived every replica) rejects its requests with ``error`` —
+        mirror of the batcher's poison-batch containment."""
+        n = 0
+        for r in requests:
+            if not r.done:
+                r.reject(REJECT_ERROR, f"{type(exc).__name__}: {exc}")
+                n += 1
+        if n:
+            self._note_reject(REJECT_ERROR, n)
+            self.telemetry.emit("serve.reject", reason=REJECT_ERROR,
+                                count=n,
+                                detail=f"{type(exc).__name__}: {exc}")
+
+    # -- fleet health / rollout ------------------------------------------
+    def healthz(self) -> dict:
+        """Liveness + (for a fleet) per-replica state: the /healthz body.
+        A fleet with zero live replicas reports ok=False — the probe that
+        tells an orchestrator to restart or reroute."""
+        if self._fleet is None:
+            return {"ok": True}
+        return self._fleet.healthz()
+
+    def rollout(self, params, batch_stats=None, *, run_config=None,
+                allow_config_change: bool = False) -> dict:
+        """Blue/green checkpoint flip (fleet engines only): see
+        ``FleetEngine.rollout``.  Single-engine services must restart —
+        there is no second engine to stage on."""
+        if self._fleet is None:
+            raise RuntimeError("rollout needs a FleetEngine "
+                               "(serve with --replicas >= 2 fleet mode)")
+        return self._fleet.rollout(params, batch_stats,
+                                   run_config=run_config,
+                                   allow_config_change=allow_config_change)
+
 
 # -- HTTP front end -----------------------------------------------------
 def make_http_handler(service: CountService):
@@ -387,8 +490,17 @@ def make_http_handler(service: CountService):
                      -> 200 {"count", "latency_ms", "bucket", "batch_fill"
                              [, "density"]}
                      -> 408/503 {"error", "reason"} on deadline/shedding
-    GET  /healthz    -> 200 {"ok": true}
+    GET  /healthz    -> 200/503 {"ok", ...}; fleet services add per-
+                     replica state (quarantine visible here), live count,
+                     generation — 503 when zero replicas are live
     GET  /stats      -> 200 stats() JSON
+    POST /rollout    (fleet only) body: JSON checkpoint source — the
+                     same keys the CLI takes ({"checkpoint_dir", "epoch",
+                     "params_npz", "torch_pth", "allow_config_change"}) —
+                     loaded via ``service.rollout_loader`` (wired by
+                     cli/serve.py), then blue/green-flipped.  Synchronous:
+                     replies with the rollout report when the last replica
+                     has flipped; live traffic keeps flowing meanwhile.
     """
     from http.server import BaseHTTPRequestHandler
     from urllib.parse import parse_qs, urlparse
@@ -419,14 +531,51 @@ def make_http_handler(service: CountService):
         def do_GET(self):
             path = urlparse(self.path).path
             if path == "/healthz":
-                self._send(200, {"ok": True})
+                health = service.healthz()
+                self._send(200 if health.get("ok") else 503, health)
             elif path == "/stats":
                 self._send(200, service.stats())
             else:
                 self._send(404, {"error": f"no such path: {path}"})
 
+        def _do_rollout(self):
+            loader = getattr(service, "rollout_loader", None)
+            if loader is None:
+                self._send(501, {"error": "rollout is not wired on this "
+                                          "server (no rollout_loader; "
+                                          "fleet CLI serves wire it)"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                spec = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(spec, dict):
+                    raise ValueError("rollout body must be a JSON object")
+            except Exception as e:  # noqa: BLE001 — client error
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                allow = bool(spec.pop("allow_config_change", False))
+                params, batch_stats, run_config = loader(spec)
+                report = service.rollout(params, batch_stats,
+                                         run_config=run_config,
+                                         allow_config_change=allow)
+            except (ValueError, RuntimeError, FileNotFoundError) as e:
+                # drift guard / structure guard / bad source: refused,
+                # the serving fleet is untouched
+                self._send(409, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — corrupt .npz,
+                # IsADirectoryError, ... must answer the client, never
+                # drop the socket with a raw handler-thread traceback
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, report)
+
         def do_POST(self):
             url = urlparse(self.path)
+            if url.path == "/rollout":
+                self._do_rollout()
+                return
             if url.path != "/predict":
                 self._send(404, {"error": f"no such path: {url.path}"})
                 return

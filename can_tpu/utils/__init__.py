@@ -1,7 +1,9 @@
 from .checkpoint import (
+    SERVE_CONFIG_KEYS,
     CheckpointManager,
     ConfigDriftError,
     check_resume_config,
+    check_serve_config,
     load_run_config,
     save_run_config,
 )
@@ -19,7 +21,9 @@ from .profiling import (
 __all__ = [
     "CheckpointManager",
     "ConfigDriftError",
+    "SERVE_CONFIG_KEYS",
     "check_resume_config",
+    "check_serve_config",
     "load_run_config",
     "save_run_config",
     "MetricLogger",

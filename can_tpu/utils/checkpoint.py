@@ -64,6 +64,25 @@ def load_run_config(directory: str) -> Optional[dict]:
         return json.load(f)
 
 
+# The run-config keys that change what a checkpoint IS for serving: the
+# model variant (syncBN decides whether batch_stats exist, i.e. the
+# predict jit signature) and the training compute dtype.  Schedule keys
+# (lr, epochs, batch, seed) are training-only — a fleet rollout between
+# checkpoints of one run must not trip on a mid-run --lr change.
+SERVE_CONFIG_KEYS = ("syncBN", "bf16")
+
+
+def check_serve_config(serving: dict, incoming: dict, *,
+                       allow: bool = False) -> List[str]:
+    """Rollout drift guard: compare only the serve-relevant keys of the
+    fleet's current run config against the incoming checkpoint's.  Same
+    contract as :func:`check_resume_config` — returns the drifted keys,
+    raises :class:`ConfigDriftError` unless ``allow``."""
+    sub = {k: serving.get(k) for k in SERVE_CONFIG_KEYS}
+    cur = {k: incoming.get(k) for k in SERVE_CONFIG_KEYS}
+    return check_resume_config(sub, cur, allow=allow)
+
+
 def check_resume_config(saved: dict, current: dict, *,
                         allow: bool = False) -> List[str]:
     """Compare a checkpoint's saved run config against the resuming run's.

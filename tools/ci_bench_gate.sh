@@ -19,6 +19,12 @@
 #       AND cost_analysis bytes (unit gbytes, gated UPWARD — bytes
 #       growing = the syncBN moments path lost a fusion)
 #
+#   CI_BENCH_ONLY=fleet tools/ci_bench_gate.sh BENCH_FLEET_cpu_r11.json
+#       gates the serving-fleet tier: per-mode open-loop p99 latency at a
+#       FIXED offered rate (unit ms, gated UPWARD only on the recorded
+#       spread floors) and throughput (req/s, gated downward), for
+#       f32/bf16/int8 through the full 2-replica fleet stack
+#
 # Environment knobs:
 #   CI_BENCH_OUT           where the fresh run's records land
 #                          (default /tmp/ci_bench_suite.jsonl)
@@ -38,6 +44,14 @@ BASELINE=${1:-BENCH_SUITE_r07.json}
 OUT=${CI_BENCH_OUT:-/tmp/ci_bench_suite.jsonl}
 ONLY=${CI_BENCH_ONLY:-host}
 
+# the fleet tier pins one device per replica; on the CPU gate box that
+# means the 8-virtual-device smoke mesh (a 1-device run would refuse
+# replicas=2 outright)
+if [ "$ONLY" = "fleet" ]; then
+    BENCH_SUITE_PLATFORM=${BENCH_SUITE_PLATFORM:-cpu8}
+    export BENCH_SUITE_PLATFORM
+fi
+
 cd "$(dirname "$0")/.."
 
 if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
@@ -52,9 +66,13 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # BENCH_BN_OUT: same baseline-overwrite trap as the perf ledger — the
     # bn tier's artifact defaults to the committed BENCH_BN_cpu_r10.json
     # exactly when BENCH_SUITE_ONLY=bn, which is how this gate runs it.
+    # BENCH_FLEET_OUT: third instance of the same trap — the fleet tier's
+    # artifact defaults to the committed BENCH_FLEET_cpu_r11.json exactly
+    # when BENCH_SUITE_ONLY=fleet, which is how this gate runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
+        BENCH_FLEET_OUT="${BENCH_FLEET_OUT:-${OUT}.fleet.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi
