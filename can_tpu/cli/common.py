@@ -278,6 +278,7 @@ def local_device_peaks() -> Optional[DevicePeaks]:
     exercisable in tests), None anywhere else."""
     try:
         dev = jax.local_devices()[0]
+    # can-tpu-lint: disable=SWALLOW(backend init failure degrades to no-peaks; MFU rows go None, documented)
     except Exception:
         return None
     try:
@@ -287,6 +288,7 @@ def local_device_peaks() -> Optional[DevicePeaks]:
             f, bw = _CPU_NOMINAL_PEAKS
             return DevicePeaks(flops_bf16=f, flops_f32=f, hbm_bytes_s=bw,
                                source="nominal:cpu", nominal=True)
+    # can-tpu-lint: disable=SWALLOW(unknown device kind degrades to no-peaks; attribution is best-effort)
     except Exception:
         pass
     return None
@@ -325,12 +327,14 @@ def device_memory_bytes() -> Optional[int]:
     must still AGREE the value — use agreed_device_memory_bytes()."""
     try:
         dev = jax.local_devices()[0]
+    # can-tpu-lint: disable=SWALLOW(backend init failure degrades to 'no ceiling', stated below)
     except Exception:
         return None  # backend init failure degrades to 'no ceiling'
     try:
         stats = dev.memory_stats()
         if stats and stats.get("bytes_limit"):
             return int(stats["bytes_limit"])
+    # can-tpu-lint: disable=SWALLOW(memory_stats is optional per PJRT client; spec-table fallback follows)
     except Exception:
         pass
     try:
@@ -343,6 +347,7 @@ def device_memory_bytes() -> Optional[int]:
                       "bytes_limit: no HBM cap will be applied",
                       flush=True)
             return spec
+    # can-tpu-lint: disable=SWALLOW(spec-table probe is best-effort; 'no HBM cap' is the documented degrade)
     except Exception:
         pass
     return None

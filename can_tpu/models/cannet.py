@@ -344,6 +344,7 @@ def _batch_norm(y, bn_params, stats, train: bool, momentum: float,
     the f32 noise floor the x64 parity worker (tests/bn_sp_x64_worker.py)
     exists to escape, making its 1e-4 bound unreachable by construction.
     """
+    # can-tpu-lint: disable=F64LIT(deliberate FLOOR check: f64 inputs keep f64 — see the x64 parity note above)
     acc_dtype = jnp.float64 if y.dtype == jnp.float64 else jnp.float32
     yf = y.astype(acc_dtype)
     if train:
@@ -425,5 +426,6 @@ if __name__ == "__main__":
 
     _p = cannet_init(_jax.random.key(0))
     _out = _jax.jit(lambda p, x: cannet_apply(p, x))(_p, _jnp.ones((1, 256, 256, 3)))
+    # can-tpu-lint: disable=HOSTSYNC(__main__ smoke print; not a library path)
     print(f"CANNet forward: {_out.shape}, mean {float(_out.mean()):.3e}, "
           f"{param_count(_p):,} params")

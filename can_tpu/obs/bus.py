@@ -210,6 +210,7 @@ class Telemetry:
             for sink in self._sinks:
                 try:
                     sink.close()
+                # can-tpu-lint: disable=SWALLOW(best-effort sink close at teardown; emit() already warned per failure streak)
                 except Exception:
                     pass
             self._sinks = []

@@ -87,6 +87,17 @@ def extract_image_signature(signature) -> Tuple[tuple, str]:
     return best[1], best[2]
 
 
+def resolve_jit(fn, args):
+    """The lowerable jitted callable behind ``fn`` for these ``args``:
+    ``jax.jit`` objects pass through, wrapped dispatchers (the bucketed/
+    spatial step closures, ``obs.RecompileTracker``) expose ``jit_for``
+    returning the underlying jit.  Shared by the cost ledger and the HLO
+    auditor (``can_tpu.analysis.hlo_audit``) so both reach the SAME
+    program an operator's step actually runs."""
+    picker = getattr(fn, "jit_for", None)
+    return picker(*args) if picker is not None else fn
+
+
 def cost_analysis_of(fn, args) -> Optional[Tuple[Optional[float],
                                                  Optional[float]]]:
     """(flops, bytes accessed) for the program ``fn(*args)`` compiles to,
@@ -100,8 +111,7 @@ def cost_analysis_of(fn, args) -> Optional[Tuple[Optional[float],
     already-slow compile path, and the persistent compilation cache (CLI
     default) turns it into a deserialise.  Never raises."""
     try:
-        picker = getattr(fn, "jit_for", None)
-        target = picker(*args) if picker is not None else fn
+        target = resolve_jit(fn, args)
         lower = getattr(target, "lower", None)
         if lower is None:
             return None
@@ -117,6 +127,7 @@ def cost_analysis_of(fn, args) -> Optional[Tuple[Optional[float],
         if flops is None and byts is None:
             return None
         return flops, byts
+    # can-tpu-lint: disable=SWALLOW(attribution must never kill a run; None row is the degrade)
     except Exception:  # noqa: BLE001 — attribution must never kill a run
         return None
 

@@ -171,7 +171,9 @@ class ServeEngine:
         doesn't fork on ``want_density``."""
         counts, density = self._predict(self.params, _batch_dict(batch),
                                         self.batch_stats)
+        # can-tpu-lint: disable=HOSTSYNC(the fetch IS the product: callers resolve waiting requests with it)
         return (np.asarray(counts),
+                # can-tpu-lint: disable=HOSTSYNC(fetched only when a request asked for the density tensor)
                 np.asarray(density) if want_density else None)
 
     @property

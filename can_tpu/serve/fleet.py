@@ -100,6 +100,7 @@ class ReplicaState:
 def _replicate(tree, devices):
     """One batched host->devices transfer: every leaf fully replicated
     over a 1-D replica mesh (NamedSharding with an empty PartitionSpec)."""
+    # can-tpu-lint: disable=HOSTSYNC(host list of device HANDLES, no device data moves)
     mesh = Mesh(np.asarray(devices), ("replica",))
     sharding = NamedSharding(mesh, PartitionSpec())
     return jax.device_put(tree, sharding)
@@ -183,10 +184,14 @@ class FleetEngine:
         ``on_fail(requests, exc)`` after a twice-failed one;
         ``on_reject(reason, count)`` counts rejections the fleet already
         emitted telemetry for (zombie-batch shedding)."""
+        # can-tpu-lint: disable=LOCKHELD(bind() happens-before start(): no worker thread exists yet)
         self._on_complete = on_complete
+        # can-tpu-lint: disable=LOCKHELD(bind() happens-before start(): no worker thread exists yet)
         self._on_fail = on_fail
+        # can-tpu-lint: disable=LOCKHELD(bind() happens-before start(): no worker thread exists yet)
         self._on_reject = on_reject
         if clock is not None:
+            # can-tpu-lint: disable=LOCKHELD(bind() happens-before start(): no worker thread exists yet)
             self._clock = clock
 
     # -- engine-compatible surface ---------------------------------------
@@ -205,6 +210,7 @@ class FleetEngine:
         per-replica jit caches are independent, so each pays its own
         compiles here and none during traffic.  The spec is remembered:
         rollout's staging warmup re-runs exactly this grid."""
+        # can-tpu-lint: disable=LOCKHELD(warmup precedes traffic; rollout reads this under _rollout_lock afterwards)
         self._warmup_spec = (sorted(set(map(tuple, bucket_shapes))),
                              int(max_batch), tuple(dtypes))
         t0 = time.perf_counter()
@@ -223,6 +229,7 @@ class FleetEngine:
     def start(self) -> "FleetEngine":
         if self._started:
             return self
+        # can-tpu-lint: disable=LOCKHELD(idempotent lifecycle flag; start runs on the owner thread)
         self._started = True
         for r in self.replicas:
             t = threading.Thread(target=self._worker, args=(r,),
@@ -244,6 +251,7 @@ class FleetEngine:
         deadline = time.monotonic() + drain_timeout_s
         for t in self._threads:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        # can-tpu-lint: disable=LOCKHELD(only close() touches _threads after start, and close is idempotent-guarded above)
         self._threads = []
         leftovers = []
         with self._cond:

@@ -206,6 +206,7 @@ class CountService:
             if self._fleet is not None:
                 self._fleet.start()
             self.batcher.start()
+            # can-tpu-lint: disable=LOCKHELD(idempotent lifecycle flag; start/close run on the owner thread)
             self._started = True
         return self
 
@@ -213,6 +214,7 @@ class CountService:
         """Stop admissions, drain in-flight work, reject the rest."""
         if self._closed:
             return
+        # can-tpu-lint: disable=LOCKHELD(monotonic flag; a submit racing the flip is rejected by queue.close below)
         self._closed = True
         for r in self.queue.close():
             r.reject(REJECT_SHUTDOWN, "service closing")
@@ -225,6 +227,7 @@ class CountService:
         ledger = getattr(self.telemetry, "ledger", None)
         if ledger is not None:
             ledger.emit_summary(self.telemetry, phase="serve_close")
+        # can-tpu-lint: disable=LOCKHELD(idempotent lifecycle flag; start/close run on the owner thread)
         self._started = False
 
     def __enter__(self) -> "CountService":
@@ -424,9 +427,15 @@ class CountService:
                 # replica's own program name
                 ledger.observe(program, tuple(batch.image.shape),
                                execute_s, dtype=str(batch.image.dtype))
-            self._perf_batches += 1
-            if 0 < self.perf_summary_every <= self._perf_batches:
-                self._perf_batches = 0
+            # under _lock: fleet replica workers call _complete
+            # concurrently, and an unlocked += here can lose counts or
+            # double-emit the periodic summary (lint: LOCKHELD)
+            with self._lock:
+                self._perf_batches += 1
+                due = 0 < self.perf_summary_every <= self._perf_batches
+                if due:
+                    self._perf_batches = 0
+            if due:
                 ledger.emit_summary(self.telemetry, phase="serve")
 
     def _note_reject(self, reason: str, count: int = 1) -> None:

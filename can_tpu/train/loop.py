@@ -295,6 +295,7 @@ def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count,
     collect = collect or health is not None
     win: dict = {}
     for i, metrics in enumerate(jax.device_get(pending)):
+        # can-tpu-lint: disable=HOSTSYNC(host value: the windowed jax.device_get above is the one sync)
         loss = float(metrics["loss"])
         step_no = step_count - window + i + 1
         if check_finite and not math.isfinite(loss):
@@ -310,13 +311,16 @@ def _flush(pending, loss_sum, img_sum, check_finite, epoch, step_count,
                 f"{window} steps (<= step {step_count}; metric checks are "
                 f"windowed — pass check_every=1 to train_one_epoch to "
                 f"pinpoint); aborting all hosts")
+        # can-tpu-lint: disable=HOSTSYNC(host value from the windowed device_get)
         n = float(metrics["num_valid"])
         loss_sum += loss
         img_sum += n
         if collect:
             per_img = loss / max(n, 1.0)
+            # can-tpu-lint: disable=HOSTSYNC(host value from the windowed device_get)
             gn = (float(metrics["grad_norm"])
                   if "grad_norm" in metrics else None)
+            # can-tpu-lint: disable=HOSTSYNC(host value from the windowed device_get)
             un = (float(metrics["update_norm"])
                   if "update_norm" in metrics else None)
             for key, v in (("loss", per_img), ("grad_norm", gn),
@@ -369,8 +373,11 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
         n_before = n_seen
         window = len(pending)
         for m in jax.device_get(pending):
+            # can-tpu-lint: disable=HOSTSYNC(host values: the windowed device_get above is the one sync)
             abs_sum += float(m["abs_err_sum"])
+            # can-tpu-lint: disable=HOSTSYNC(host value from the windowed device_get)
             sq_sum += float(m["sq_err_sum"])
+            # can-tpu-lint: disable=HOSTSYNC(host value from the windowed device_get)
             n_seen += float(m["num_valid"])
         pending.clear()
         if telemetry is not None and window:
@@ -406,6 +413,7 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
             f"eval saw {int(n_seen)} valid samples, expected {dataset_size}")
     return {
         "mae": abs_sum / dataset_size,
+        # can-tpu-lint: disable=HOSTSYNC(host numpy sqrt of epoch sums)
         "mse": float(np.sqrt(sq_sum / dataset_size)),
         "num_images": dataset_size,
     }

@@ -175,6 +175,7 @@ class CheckpointManager:
         try:
             metrics = self.manager.metrics(step)
             return float(metrics["mae"]) if metrics else None
+        # can-tpu-lint: disable=SWALLOW(absent/corrupt best-step metrics mean 'no prior best'; resume proceeds)
         except Exception:
             return None
 

@@ -57,6 +57,7 @@ def device_watchdog(seconds: float = 300.0, on_timeout=None):
             if on_timeout is not None:
                 try:
                     on_timeout()
+                # can-tpu-lint: disable=SWALLOW(process is about to _exit(3); the fatal print below is the record)
                 except Exception:
                     pass
             print(f"[watchdog] FATAL: no JAX device within {seconds:.0f}s "

@@ -598,31 +598,28 @@ class TestSyncBNOnePassSpatial:
 
     def test_onepass_issues_fewer_collectives(self):
         """The lowered dp x sp BN train step must carry strictly fewer
-        all_reduce ops under onepass: two psum rounds per BN layer
-        (twopass) collapse into one packed round x 13 layers."""
-        import re
+        all_reduce ops under onepass, and its moment rounds must be the
+        packed ``(2C+1,)`` vectors — one per BN layer per pass.  Counting
+        now rides the program-contract analyzer (the one implementation
+        the committed PROGRAM_CONTRACTS.json audit also uses —
+        can_tpu/analysis/hlo_audit.py; the hand-rolled regex this test
+        carried is deleted)."""
+        from can_tpu.analysis import hlo_audit
 
-        from can_tpu.ops.bn_moments import make_bn_ops
-        from can_tpu.parallel.spatial import make_sp_train_step
-
-        mesh = make_mesh(jax.devices()[:8], dp=2, sp=4)
-        h, w = 128, 96
-        params = cannet_init(jax.random.key(0), batch_norm=True)
-        opt = make_optimizer(make_lr_schedule(1e-3, world_size=2))
-        state = create_train_state(params, opt, init_batch_stats(params))
-        batch = {
-            "image": jnp.zeros((2, h, w, 3), jnp.float32),
-            "dmap": jnp.zeros((2, h // 8, w // 8, 1), jnp.float32),
-            "pixel_mask": jnp.ones((2, h // 8, w // 8, 1), jnp.float32),
-            "sample_mask": jnp.ones((2,), jnp.float32),
+        facts = {
+            impl: hlo_audit.program_facts(f"train_step_syncbn_{impl}")
+            for impl in ("twopass", "onepass")
         }
-        counts = {}
-        for impl in ("twopass", "onepass"):
-            step = make_sp_train_step(opt, mesh, (h, w), donate=False,
-                                      bn_ops=make_bn_ops(impl))
-            txt = step.lower(state, batch).as_text()
-            counts[impl] = len(re.findall(r"all_reduce", txt))
+        counts = {impl: f.collectives["all_reduce"]
+                  for impl, f in facts.items()}
         assert counts["onepass"] < counts["twopass"], counts
+        chans = hlo_audit.bn_channels()
+        # onepass: every BN layer contributes one packed forward psum
+        # plus its transpose in backward; twopass has none
+        assert hlo_audit.packed_bn_reduce_count(
+            facts["onepass"].all_reduce_shapes, chans) == 2 * len(chans)
+        assert hlo_audit.packed_bn_reduce_count(
+            facts["twopass"].all_reduce_shapes, chans) == 0
 
 
 class TestBNImplDefaultByteIdentity:

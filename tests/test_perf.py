@@ -12,10 +12,8 @@ honest:
   ``tools/ci_bench_gate.sh`` compare-only mode.
 """
 
-import glob
 import json
 import os
-import re
 import subprocess
 import sys
 
@@ -363,24 +361,22 @@ class TestEventKindsDrift:
     def test_emit_literals_match_declared_kinds_both_ways(self):
         """Every ``.emit("<kind>", ...)`` literal in the library, bench
         entry points, and tools is declared in EVENT_KINDS — and every
-        declared kind has at least one emitter.  A new event kind that
-        skips the declaration breaks report/gauge coverage silently;
-        this makes it loud."""
-        paths = (glob.glob(os.path.join(REPO, "can_tpu", "**", "*.py"),
-                           recursive=True)
-                 + glob.glob(os.path.join(REPO, "bench*.py"))
-                 + glob.glob(os.path.join(REPO, "tools", "*.py")))
-        assert len(paths) > 40  # the scan actually found the tree
-        emitted = set()
-        pat = re.compile(r'\.emit\(\s*"([a-z_.]+)"')
-        for p in paths:
-            with open(p) as f:
-                emitted |= set(pat.findall(f.read()))
-        declared = set(obs.EVENT_KINDS)
-        assert emitted - declared == set(), (
-            f"emitted but not in EVENT_KINDS: {emitted - declared}")
-        assert declared - emitted == set(), (
-            f"declared but never emitted: {declared - emitted}")
+        declared kind has at least one emitter.  The scan is the source
+        linter's EMITKIND rule (can_tpu/analysis/source_lint.py — the
+        grep this test hand-rolled is deleted; one implementation, this
+        test is the thin assertion), cross-checked against the imported
+        EVENT_KINDS so the linter's AST parse of obs/bus.py can't drift
+        from the real tuple either."""
+        from can_tpu.analysis import source_lint
+
+        assert len(source_lint.default_paths(REPO)) > 40  # found the tree
+        undeclared, unemitted = source_lint.emit_kind_drift(REPO)
+        assert undeclared == {}, (
+            f"emitted but not in EVENT_KINDS: {undeclared}")
+        assert unemitted == [], (
+            f"declared but never emitted: {unemitted}")
+        kinds, _ = source_lint.declared_event_kinds(REPO)
+        assert tuple(kinds) == tuple(obs.EVENT_KINDS)
 
 
 # --- default-run byte identity ------------------------------------------
