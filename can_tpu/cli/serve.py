@@ -126,6 +126,17 @@ def parse_args(argv=None):
                         "config covers training and serving")
     p.add_argument("--metrics-host", type=str, default="127.0.0.1",
                    help="bind address for --metrics-port")
+    p.add_argument("--incident-dir", type=str, default="",
+                   help="arm the incident layer: a replica quarantine, a "
+                        "fast SLO burn, or a SIGTERM dumps a "
+                        "self-contained bundle (flight-recorder ring + "
+                        "gauges + live serve stats + stacks) here — see "
+                        "the train CLI / obs/incidents.py")
+    p.add_argument("--slo-spec", type=str, default="",
+                   help="JSON SLO spec (slo_spec.json): serve p99 vs "
+                        "deadline, reject rate, ... evaluated live as "
+                        "multi-window burn rates; can_tpu_slo_* gauges "
+                        "on /metrics are the autoscaler's signal")
     return p.parse_args(argv)
 
 
@@ -252,10 +263,12 @@ def main(argv=None) -> int:
         apply_compile_cache,
         apply_platform,
         build_telemetry,
+        validate_incident_args,
     )
     from can_tpu.parallel import init_runtime, process_index, shutdown_runtime
     from can_tpu.serve import serve_http
 
+    validate_incident_args(args)
     apply_platform(args)
     init_runtime()
     apply_compile_cache(args, announce=True)
@@ -281,11 +294,11 @@ def main(argv=None) -> int:
                 httpd.server_close()
         return 0
     finally:
-        if heartbeat is not None:
-            heartbeat.close()
-        if exporter is not None:
-            exporter.close()
-        telemetry.close()
+        from can_tpu.obs import shutdown_telemetry
+
+        # deterministic order shared with the SIGTERM path (lifecycle.py)
+        shutdown_telemetry(telemetry, heartbeat=heartbeat,
+                           exporter=exporter)
         shutdown_runtime()
 
 

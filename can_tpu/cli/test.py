@@ -126,6 +126,14 @@ def parse_args(argv=None):
                         "watching too)")
     p.add_argument("--metrics-host", type=str, default="127.0.0.1",
                    help="bind address for --metrics-port")
+    p.add_argument("--incident-dir", type=str, default="",
+                   help="arm the incident layer: flight-recorder ring + "
+                        "trigger-dumped bundles + SIGTERM/preemption "
+                        "hook (see the train CLI; long high-res evals "
+                        "die to preemption too)")
+    p.add_argument("--slo-spec", type=str, default="",
+                   help="JSON SLO spec evaluated live as multi-window "
+                        "burn rates (see the train CLI / slo_spec.json)")
     p.add_argument("--max-buckets", type=int, default=24,
                    help="compile budget for --pad-multiple auto (distinct "
                         "(shape x batch-size) programs)")
@@ -214,10 +222,12 @@ def main(argv=None) -> int:
         apply_platform,
         build_telemetry,
         resolve_num_workers,
+        validate_incident_args,
         validate_trace_args,
     )
 
     trace_window = validate_trace_args(args)
+    validate_incident_args(args)
     apply_platform(args)
     init_runtime()
     apply_compile_cache(args)
@@ -225,7 +235,8 @@ def main(argv=None) -> int:
         args, host_id=process_index(), trace_window=trace_window)
     # loop instrumentation only when something consumes it (see train CLI)
     loop_tel = telemetry if (args.telemetry_dir or trace_window
-                             or exporter is not None) else None
+                             or exporter is not None or args.incident_dir
+                             or args.slo_spec) else None
     try:
         params, batch_stats = load_params(args)
         compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -390,11 +401,11 @@ def main(argv=None) -> int:
             print(f"[viz] wrote {paths}")
         return 0
     finally:
-        if heartbeat is not None:
-            heartbeat.close()
-        if exporter is not None:
-            exporter.close()
-        telemetry.close()
+        from can_tpu.obs import shutdown_telemetry
+
+        # deterministic order shared with the SIGTERM path (lifecycle.py)
+        shutdown_telemetry(telemetry, heartbeat=heartbeat,
+                           exporter=exporter)
         shutdown_runtime()  # the reference leaks its process group (SURVEY §3.1)
 
 

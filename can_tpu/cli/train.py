@@ -168,6 +168,25 @@ def parse_args(argv=None):
     p.add_argument("--metrics-host", type=str, default="127.0.0.1",
                    help="bind address for --metrics-port (0.0.0.0 to let "
                         "a fleet scraper reach every host)")
+    p.add_argument("--incident-dir", type=str, default="",
+                   help="arm the incident layer (obs/incidents.py): a "
+                        "flight-recorder ring retains the last N events "
+                        "of telemetry, and any trigger — NaN/stall-budget "
+                        "health alert, replica quarantine, unhandled loop "
+                        "exception, SIGTERM/preemption — dumps a "
+                        "self-contained bundle (ring + gauges + cost "
+                        "ledger + all-thread stacks + device memory + "
+                        "run config) into this directory, rate-limited "
+                        "and retention-bounded.  Default off: no "
+                        "recorder, no signal hook")
+    p.add_argument("--slo-spec", type=str, default="",
+                   help="JSON SLO spec (see slo_spec.json): objectives "
+                        "evaluated live as multi-window error-budget "
+                        "burn rates over the telemetry stream — slo.burn "
+                        "events, can_tpu_slo_* gauges on /metrics, and "
+                        "incident bundles on fast burn (with "
+                        "--incident-dir).  Grade a finished run with "
+                        "tools/slo_report.py")
     p.add_argument("--max-steps-per-epoch", type=int, default=0,
                    help="truncate epochs (smoke tests); 0 = full epoch")
     p.add_argument("--platform", type=str, default="default",
@@ -279,28 +298,76 @@ def validate_trace_args(args):
     return window
 
 
-def build_telemetry(args, *, host_id: int, trace_window, logger=None):
+def validate_incident_args(args) -> None:
+    """Pure arg/path validation for the incident/SLO flags — run BEFORE
+    any runtime init (a typo'd spec must not cost a multi-host
+    rendezvous, the same contract as the dataset path checks).  Shared
+    by all three CLIs."""
+    spec_path = getattr(args, "slo_spec", "")
+    if spec_path:
+        from can_tpu.obs.slo import load_slo_spec
+
+        try:
+            # stash the PARSED spec: build_telemetry runs after
+            # init_runtime, and re-reading the file there would reopen
+            # the post-rendezvous failure window this validation closes
+            # (a spec replaced mid-launch on a shared FS)
+            args._slo_spec_parsed = load_slo_spec(spec_path)
+        except OSError as e:
+            raise SystemExit(f"--slo-spec: cannot read {spec_path}: {e}")
+        except ValueError as e:
+            raise SystemExit(f"--slo-spec: {e}")
+    incident_dir = getattr(args, "incident_dir", "")
+    if incident_dir:
+        import os as _os
+
+        try:
+            _os.makedirs(incident_dir, exist_ok=True)
+        except OSError as e:
+            raise SystemExit(f"--incident-dir: cannot create "
+                             f"{incident_dir}: {e}")
+
+
+def build_telemetry(args, *, host_id: int, trace_window, logger=None,
+                    install_signals: bool = True):
     """The CLIs' shared wiring: per-host JSONL sink (``--telemetry-dir``),
     MetricLogger adapter (epoch scalars keep flowing to stdout/wandb
     unchanged), optional step-range trace window, heartbeat thread, and —
     with ``--metrics-port`` — an in-memory gauge sink plus the live
-    Prometheus exporter (obs/exporter.py).  Returns
-    ``(telemetry, heartbeat_or_None, exporter_or_None)``."""
+    Prometheus exporter (obs/exporter.py).  ``--incident-dir`` adds the
+    flight recorder + IncidentManager (+ the SIGTERM/preemption hook,
+    unless ``install_signals=False`` — in-process tests must not retarget
+    the interpreter's signal table); ``--slo-spec`` adds the SLO
+    burn-rate engine.  Returns
+    ``(telemetry, heartbeat_or_None, exporter_or_None)`` — tear the
+    stack down with ``obs.shutdown_telemetry`` (one deterministic order
+    for clean exit and SIGTERM alike)."""
     from can_tpu import obs
 
     trace = (obs.StepTraceWindow(args.profile_dir, *trace_window)
              if trace_window else None)
     extra = [obs.MetricLoggerSink(logger)] if logger is not None else []
     exporter = None
+    gauges = None
     metrics_port = getattr(args, "metrics_port", None)
-    if metrics_port is not None:
+    incident_dir = getattr(args, "incident_dir", "")
+    slo_spec_path = getattr(args, "slo_spec", "")
+    if metrics_port is not None or incident_dir or slo_spec_path:
+        # the gauge sink exists for ANY of its three consumers: the
+        # scrape endpoint, the bundle's gauges.json snapshot, and the
+        # SLO layer's can_tpu_slo_* exports
         gauges = obs.GaugeSink()
         extra.append(gauges)
+    if metrics_port is not None:
         exporter = obs.MetricsExporter(
             gauges, host=getattr(args, "metrics_host", "127.0.0.1"),
             port=metrics_port).start()
         print(f"[metrics] /metrics + /healthz on "
               f"http://{exporter.host}:{exporter.port}")
+    recorder = None
+    if incident_dir:
+        recorder = obs.FlightRecorder()
+        extra.append(recorder)
     if args.telemetry_dir:
         tel = obs.open_host_telemetry(args.telemetry_dir, host_id=host_id,
                                       extra_sinks=extra, trace=trace)
@@ -308,20 +375,48 @@ def build_telemetry(args, *, host_id: int, trace_window, logger=None):
         tel = obs.Telemetry(extra, host_id=host_id, trace=trace)
     # performance-attribution collaborators ride the same arming rule as
     # the loop instrumentation: any consumer (JSONL artifact, live
-    # /metrics scraper, trace window) arms the cost ledger + span tracer;
-    # a default run constructs neither, so nothing new can touch its hot
-    # path.  The ledger prices MFU against the run's COMPUTE dtype.
-    if args.telemetry_dir or exporter is not None or trace_window:
+    # /metrics scraper, trace window, incident recorder, SLO engine)
+    # arms the cost ledger + span tracer; a default run constructs
+    # neither, so nothing new can touch its hot path.  The ledger prices
+    # MFU against the run's COMPUTE dtype.
+    if (args.telemetry_dir or exporter is not None or trace_window
+            or incident_dir or slo_spec_path):
         tel.ledger = obs.ProgramCostLedger(
             compute="bf16" if getattr(args, "bf16", False) else "f32")
         tel.spans = obs.SpanTracer(tel)
-    tel.emit("run", config={k: v for k, v in vars(args).items()
-                            if isinstance(v, (str, int, float, bool,
-                                              type(None)))})
-    # heartbeat whenever an artifact OR a live scraper consumes it (the
-    # exporter's last_heartbeat_ts gauge is the probe's staleness signal)
+    run_config = {k: v for k, v in vars(args).items()
+                  if isinstance(v, (str, int, float, bool, type(None)))}
+    if slo_spec_path:
+        # the spec validate_incident_args already parsed (pre-init, so
+        # a bad file can't cost a rendezvous); loaded here only for
+        # callers that skipped validation.  Watcher order vs the
+        # incident manager is irrelevant — slo.burn alerts reach it
+        # through the bus's own watcher fan-out.
+        spec = getattr(args, "_slo_spec_parsed", None)
+        if spec is None:
+            spec = obs.load_slo_spec(slo_spec_path)
+        tel.watchers.append(obs.SloEngine(spec, tel))
+    if incident_dir:
+        manager = obs.IncidentManager(tel, recorder,
+                                      incident_dir=incident_dir,
+                                      gauges=gauges,
+                                      run_config=run_config,
+                                      host_id=host_id)
+        tel.watchers.append(manager)
+        tel.incidents = manager
+        if install_signals:
+            # SIGTERM/preemption: dump + flush a bundle, then SystemExit
+            # into the CLI's finally -> shutdown_telemetry (same order
+            # as a clean exit); None off the main thread
+            obs.install_sigterm_handler(manager)
+    tel.emit("run", config=run_config)
+    # heartbeat whenever an artifact OR a live consumer wants liveness:
+    # the exporter's last_heartbeat_ts gauge is the probe's staleness
+    # signal, the ring's heartbeat tail dates a preempted bundle, and
+    # heartbeats drive SLO evaluation on otherwise-quiet runs
     hb = (obs.Heartbeat(tel, args.telemetry_heartbeat_s)
-          if (args.telemetry_dir or exporter is not None) else None)
+          if (args.telemetry_dir or exporter is not None or incident_dir
+              or slo_spec_path) else None)
     return tel, hb, exporter
 
 
@@ -401,12 +496,15 @@ def main(argv=None) -> int:
             if drifted:
                 print(f"[resume] config drift allowed: {', '.join(drifted)}")
     trace_window = validate_trace_args(args)
+    validate_incident_args(args)
     # per-step instrumentation is on when ANY consumer exists: JSONL
-    # artifact, trace window, or a live /metrics scraper.  Known before
-    # any runtime work so the step builders can compile the health
-    # scalars in; a default run keeps the exact pre-PR programs.
+    # artifact, trace window, live /metrics scraper, incident recorder,
+    # or SLO engine.  Known before any runtime work so the step builders
+    # can compile the health scalars in; a default run keeps the exact
+    # pre-PR programs.
     instrument = bool(args.telemetry_dir or trace_window
-                      or args.metrics_port is not None)
+                      or args.metrics_port is not None
+                      or args.incident_dir or args.slo_spec)
     apply_platform(args)
     topo = init_runtime()
     main_proc = is_main_process()
@@ -746,11 +844,14 @@ def main(argv=None) -> int:
         test_batcher.close()
         ckpt.wait()
         ckpt.close()
-        if heartbeat is not None:
-            heartbeat.close()
-        if exporter is not None:
-            exporter.close()
-        telemetry.close()  # stops a still-open trace window, closes sinks
+        # one deterministic teardown order for clean exit AND the
+        # SIGTERM path (obs/lifecycle.py): heartbeat -> watchers+sinks
+        # (final SLO eval lands in the artifact, signal handlers
+        # restored, trace window stopped) -> exporter
+        from can_tpu.obs import shutdown_telemetry
+
+        shutdown_telemetry(telemetry, heartbeat=heartbeat,
+                           exporter=exporter)
         logger.finish()
         shutdown_runtime()  # the reference never calls its cleanup()
     if main_proc:

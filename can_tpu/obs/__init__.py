@@ -29,12 +29,15 @@ from .bus import (
 )
 from .costs import ProgramCostLedger
 from .exporter import GaugeSink, MetricsExporter, render_stats
+from .flightrec import FlightRecorder
 from .health import (
     EwmaMadDetector,
     HealthMonitor,
     PlateauDetector,
     ThroughputDetector,
 )
+from .incidents import IncidentManager, install_sigterm_handler
+from .lifecycle import shutdown_telemetry
 from .report import format_report, read_events, read_events_counted, summarize
 from .sources import (
     Heartbeat,
@@ -43,21 +46,27 @@ from .sources import (
     device_memory_snapshot,
     emit_memory,
 )
+from .slo import SloEngine, SloObjective, SloSpec, grade_events, load_slo_spec
 from .spans import SpanTracer
 from .trace import StepTraceWindow, parse_trace_steps
 
 __all__ = [
     "EVENT_KINDS",
     "EwmaMadDetector",
+    "FlightRecorder",
     "GaugeSink",
     "Heartbeat",
     "HealthMonitor",
+    "IncidentManager",
     "JsonlSink",
     "MetricLoggerSink",
     "MetricsExporter",
     "PlateauDetector",
     "ProgramCostLedger",
     "RecompileTracker",
+    "SloEngine",
+    "SloObjective",
+    "SloSpec",
     "SpanTracer",
     "StallClock",
     "StdoutSink",
@@ -67,10 +76,14 @@ __all__ = [
     "device_memory_snapshot",
     "emit_memory",
     "format_report",
+    "grade_events",
+    "install_sigterm_handler",
+    "load_slo_spec",
     "open_host_telemetry",
     "parse_trace_steps",
     "read_events",
     "read_events_counted",
     "render_stats",
+    "shutdown_telemetry",
     "summarize",
 ]

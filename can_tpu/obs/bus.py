@@ -62,6 +62,12 @@ import numpy as np
 # fleet.replica is a replica state transition (quarantine on failure,
 # generation bump on rollout flip) and fleet.rollout is one completed
 # blue/green checkpoint rollout report.
+# incident.bundle and slo.burn come from the incident layer:
+# incident.bundle records one written incident bundle (obs/incidents.py
+# — reason/severity/path/suppressed counts; GaugeSink counts them as
+# can_tpu_incidents_total{reason}), and slo.burn is one objective's
+# multi-window burn-rate evaluation (obs/slo.py — exported as
+# can_tpu_slo_* gauges; `alerting` payloads trigger incident bundles).
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
@@ -69,7 +75,8 @@ EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "fleet.replica", "fleet.rollout",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",
-               "perf.summary", "trace.span")
+               "perf.summary", "trace.span",
+               "incident.bundle", "slo.burn")
 
 
 def _jsonable(v):
@@ -157,7 +164,15 @@ class Telemetry:
         self.host_id = host_id
         self.trace = trace
         self._clock = clock
-        self._lock = threading.Lock()
+        # RLock, not Lock: the SIGTERM/preemption hook (obs/incidents.py)
+        # runs ON the main thread at a bytecode boundary — if the signal
+        # lands while that thread is inside this very lock (the sink
+        # fan-out below), the handler's own bundle emit must be able to
+        # re-enter or the process deadlocks in the exact window the
+        # incident layer exists to survive.  Each sink.emit writes whole
+        # events (one write call per line), so a re-entrant fan-out
+        # interleaves complete events, never torn ones.
+        self._lock = threading.RLock()
         self._step = 0
         # RecompileTracker keeps per-wrapped-step-name signature sets here
         # so re-wrapping each epoch doesn't re-attribute old signatures
@@ -167,6 +182,16 @@ class Telemetry:
         # ledger = obs.costs.ProgramCostLedger, spans = obs.spans.SpanTracer
         self.ledger = None
         self.spans = None
+        # watchers: called with every event AFTER sink fan-out and
+        # OUTSIDE the bus lock, so a watcher may itself emit (the
+        # incident manager dumps a bundle + emits incident.bundle; the
+        # SLO engine emits slo.burn) without deadlocking.  Armed by the
+        # CLIs (obs/incidents.py, obs/slo.py); the default empty list
+        # costs one truth test per event.  ``incidents`` is the armed
+        # IncidentManager (or None) — the handle the loops use to
+        # snapshot an unhandled exception before the stack unwinds.
+        self.watchers: list = []
+        self.incidents = None
 
     @property
     def step(self) -> int:
@@ -201,11 +226,34 @@ class Telemetry:
                         print(f"[telemetry] sink {type(sink).__name__} "
                               f"failed ({type(e).__name__}: {e}); kept — "
                               f"will retry on the next event", flush=True)
+        for watcher in tuple(self.watchers):
+            try:
+                watcher.on_event(event)
+                watcher._telemetry_warned = False
+            except Exception as e:  # noqa: BLE001 — same contract as
+                # sinks: observation must never kill the run (warn once
+                # per failure streak, keep the watcher)
+                if not getattr(watcher, "_telemetry_warned", False):
+                    watcher._telemetry_warned = True
+                    print(f"[telemetry] watcher {type(watcher).__name__} "
+                          f"failed ({type(e).__name__}: {e}); kept",
+                          flush=True)
 
     def close(self) -> None:
         if self.trace is not None:
             self.trace.close()
             self.trace = None
+        # watchers BEFORE sinks: their close() may emit final events
+        # (the SLO engine's tail evaluation) that must still land in the
+        # open sinks; the incident manager restores signal handlers here
+        for watcher in tuple(self.watchers):
+            try:
+                watcher.close()
+            # can-tpu-lint: disable=SWALLOW(best-effort watcher close at teardown, mirrors the sink-close rule below)
+            except Exception:
+                pass
+        self.watchers = []
+        self.incidents = None
         with self._lock:
             for sink in self._sinks:
                 try:

@@ -109,9 +109,16 @@ class GaugeSink:
 
     def __init__(self, prefix: str = "can_tpu"):
         self.prefix = prefix
-        self._lock = threading.Lock()
+        # RLock: the SIGTERM bundle's gauge snapshot may interrupt the
+        # main thread inside emit()'s own critical section — same-thread
+        # re-entry must succeed (see obs/incidents.py)
+        self._lock = threading.RLock()
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[Tuple[str, tuple], float] = {}
+        # labelled gauges (the SLO layer's per-objective/window burns):
+        # key (name, ((label, value), ...)), rendered in the same group
+        # as any same-named plain gauge
+        self._labelled: Dict[Tuple[str, tuple], float] = {}
 
     # -- bus sink protocol ----------------------------------------------
     def emit(self, event: dict) -> None:
@@ -186,6 +193,31 @@ class GaugeSink:
                 if str(p.get("state")) == "quarantined":
                     self._count((f"{pre}_fleet_quarantines_total",
                                  (("replica", str(p.get("replica", "?"))),)))
+            elif kind == "slo.burn":
+                # one objective's multi-window burn evaluation
+                # (obs/slo.py): per-window burns and the alerting state
+                # become labelled gauges — the admission / scale-up
+                # signal an autoscaler scrapes — and alert transitions
+                # count.  A window below min_samples has burn None and
+                # emits nothing (absence beats a fake zero).
+                name = str(p.get("objective", "?"))
+                for w, info in (p.get("windows") or {}).items():
+                    burn = (info.get("burn")
+                            if isinstance(info, dict) else None)
+                    if burn is not None:
+                        self._labelled[(f"{pre}_slo_burn",
+                                        (("objective", name),
+                                         ("window_s", str(w))))] = \
+                            float(burn)
+                self._labelled[(f"{pre}_slo_alerting",
+                                (("objective", name),))] = \
+                    1.0 if p.get("alerting") else 0.0
+                if p.get("alerting"):
+                    self._count((f"{pre}_slo_alerts_total",
+                                 (("objective", name),)))
+            elif kind == "incident.bundle":
+                self._count((f"{pre}_incidents_total",
+                             (("reason", str(p.get("reason", "?"))),)))
             elif kind == "perf.summary":
                 # performance-attribution aggregates (obs/costs.py
                 # ProgramCostLedger.summary): the payload keys are already
@@ -214,7 +246,24 @@ class GaugeSink:
     def render(self) -> str:
         with self._lock:
             return render_prometheus(dict(self._gauges),
-                                     dict(self._counters))
+                                     dict(self._counters),
+                                     dict(self._labelled))
+
+    def snapshot(self) -> dict:
+        """JSON-ready point-in-time copy of every gauge and counter —
+        what an incident bundle freezes (obs/incidents.py): the same
+        values a /metrics scrape would have shown at the moment of
+        death, without needing the exporter to still be alive."""
+        with self._lock:
+            return {
+                "gauges": dict(self._gauges),
+                "labelled_gauges": [
+                    {"name": n, "labels": dict(labels), "value": v}
+                    for (n, labels), v in sorted(self._labelled.items())],
+                "counters": [
+                    {"name": n, "labels": dict(labels), "value": v}
+                    for (n, labels), v in sorted(self._counters.items())],
+            }
 
 
 def render_stats(stats: dict, *, prefix: str = "can_tpu_serve",

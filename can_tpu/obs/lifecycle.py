@@ -1,0 +1,43 @@
+"""Deterministic telemetry teardown, shared by every CLI and exit path.
+
+Before this helper each CLI hand-ordered its own close calls, and the
+order MATTERS: a heartbeat thread still emitting into closing sinks
+races file closes; an exporter shut before the final SLO evaluation
+never exposes the run's last burn values; sinks closed before the
+watchers flush lose the final ``slo.burn`` / signal-restore work.  The
+one correct order is:
+
+1. **heartbeat** — stop the only background EMITTER first, so nothing
+   new enters the bus while it drains.
+2. **telemetry.close()** — which itself closes watchers (final SLO
+   evaluation lands its last events in the still-open sinks; the
+   incident manager restores any signal handlers) and THEN the sinks.
+3. **exporter** — last, so a scraper polling through the shutdown can
+   still read the final gauge values the watcher flush just produced
+   (the ``GaugeSink`` is in-memory; it outlives the bus harmlessly).
+
+Both exits use it: the clean path (CLI ``finally``) and the SIGTERM
+path (``obs/incidents.py`` dumps the bundle in the handler, raises
+``SystemExit``, and the same ``finally`` runs the same order).
+Idempotent — a double call (signal during teardown) is a no-op.
+"""
+
+from __future__ import annotations
+
+
+def shutdown_telemetry(telemetry, *, heartbeat=None, exporter=None) -> None:
+    """Close a ``build_telemetry`` stack in the documented order.  Every
+    argument may be None; every step is individually guarded so one
+    failing close cannot leak the others."""
+    for step in (
+        (lambda: heartbeat.close()) if heartbeat is not None else None,
+        (lambda: telemetry.close()) if telemetry is not None else None,
+        (lambda: exporter.close()) if exporter is not None else None,
+    ):
+        if step is None:
+            continue
+        try:
+            step()
+        except Exception as e:  # noqa: BLE001 — teardown must finish
+            print(f"[telemetry] teardown step failed "
+                  f"({type(e).__name__}: {e}); continuing", flush=True)

@@ -83,6 +83,10 @@ def summarize(events: Iterable[dict]) -> dict:
     prepared_splits: dict = {}
     alerts: dict = {}
     health_last: Optional[dict] = None
+    incidents_by_reason: dict = {}
+    incident_last: Optional[dict] = None
+    slo_last: dict = {}
+    slo_alert_events = 0
     for e in events:
         kind = e.get("kind", "?")
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -162,6 +166,15 @@ def summarize(events: Iterable[dict]) -> dict:
             fleet_states[rk] = str(p.get("state", "?"))  # last state wins
             if p.get("state") == "quarantined":
                 fleet_quarantines[rk] = fleet_quarantines.get(rk, 0) + 1
+        elif kind == "incident.bundle":
+            reason = str(p.get("reason", "?"))
+            incidents_by_reason[reason] = \
+                incidents_by_reason.get(reason, 0) + 1
+            incident_last = p  # the freshest bundle is the triage entry
+        elif kind == "slo.burn":
+            slo_last[str(p.get("objective", "?"))] = p  # last eval wins
+            if p.get("alerting"):
+                slo_alert_events += 1
         elif kind == "perf.summary":
             perf_last = p  # the ledger is cumulative: the last wins
         elif kind == "trace.span":
@@ -254,6 +267,20 @@ def summarize(events: Iterable[dict]) -> dict:
                               if perf_last else None),
         "trace_spans": by_kind.get("trace.span", 0),
         "trace_spans_by_name": dict(sorted(span_names.items())),
+        # incident layer (can_tpu/obs/incidents.py + slo.py); zeros/empty
+        # when never armed
+        "incidents": sum(incidents_by_reason.values()),
+        "incidents_by_reason": dict(sorted(incidents_by_reason.items())),
+        "incident_last_path": (incident_last.get("path")
+                               if incident_last else None),
+        "slo_objectives": {
+            name: {"burn_min": p.get("burn_min"),
+                   "burn_max": p.get("burn_max"),
+                   "alerting": bool(p.get("alerting")),
+                   "run_good": p.get("run_good"),
+                   "run_bad": p.get("run_bad")}
+            for name, p in sorted(slo_last.items())},
+        "slo_alert_events": slo_alert_events,
     }
 
 
@@ -339,6 +366,23 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
         rows.append(("trace spans",
                      f"{summary['trace_spans']} ("
                      + " ".join(f"{k}={n}" for k, n in names.items()) + ")"))
+    if summary.get("incidents"):
+        by_reason = summary.get("incidents_by_reason") or {}
+        rows.append(("incidents",
+                     " ".join(f"{k}={n}" for k, n in by_reason.items())))
+        if summary.get("incident_last_path"):
+            rows.append(("last bundle", summary["incident_last_path"]))
+    if summary.get("slo_objectives"):
+        parts = []
+        for name, o in summary["slo_objectives"].items():
+            burn = o.get("burn_max")
+            tag = _fmt(burn) if burn is not None else "-"
+            parts.append(f"{name}={tag}"
+                         + ("(ALERT)" if o.get("alerting") else ""))
+        rows.append(("SLO burn (max)", " ".join(parts)))
+        if summary.get("slo_alert_events"):
+            rows.append(("SLO alert evals",
+                         _fmt(summary["slo_alert_events"])))
     if summary.get("health_alerts"):
         by_kind = summary.get("health_alerts_by_kind") or {}
         rows.append(("health alerts",

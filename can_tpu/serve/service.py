@@ -206,6 +206,13 @@ class CountService:
             if self._fleet is not None:
                 self._fleet.start()
             self.batcher.start()
+            inc = getattr(self.telemetry, "incidents", None)
+            if inc is not None:
+                # an incident bundle dumped while this service is alive
+                # (replica quarantine, SLO burn, SIGTERM) carries the
+                # live serving stats — queue depth, rejects, per-replica
+                # health/generation — in its manifest (obs/incidents.py)
+                inc.add_info_source("serve_stats", self.stats)
             # can-tpu-lint: disable=LOCKHELD(idempotent lifecycle flag; start/close run on the owner thread)
             self._started = True
         return self

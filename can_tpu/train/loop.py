@@ -121,6 +121,21 @@ def _emit_epoch_telemetry(telemetry, timer, stall, *, phase: str,
         ledger.emit_summary(telemetry, step=epoch, phase=phase)
 
 
+def _notify_incident(telemetry, exc, *, phase: str, epoch: int,
+                     step: int) -> None:
+    """An exception is about to unwind through the loop: give the armed
+    IncidentManager (``Telemetry.incidents``, obs/incidents.py) one shot
+    at snapshotting the run's context — ring, gauges, stacks — while it
+    still exists.  ``NonFiniteLossError`` is deliberately NOT routed
+    here: its bundle was already dumped by the ``health.alert`` nan
+    trigger inside ``_flush``, and a second one would double-report the
+    same death.  No-op (one getattr) when incidents are unarmed."""
+    inc = (getattr(telemetry, "incidents", None)
+           if telemetry is not None else None)
+    if inc is not None and not isinstance(exc, NonFiniteLossError):
+        inc.on_exception(exc, phase=phase, epoch=epoch, step=step)
+
+
 def _emit_step_window(telemetry, samples, *, steps: int, phase: str,
                       epoch: int, t_window: float, images: float,
                       **scalars) -> float:
@@ -195,51 +210,64 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
     it = _progress(prefetch_to_device(batches, put_fn, depth=prefetch,
                                       stall=stall),
                    enabled=show_progress, desc=f"epoch {epoch}", total=total)
-    for dev_batch in it:
-        shape = tuple(dev_batch["image"].shape)
-        shapes.add(shape)
-        if telemetry is not None:
-            telemetry.step_tick()
-            timer.start()
-        state, metrics = train_step(state, dev_batch)
-        if telemetry is not None:
-            # a first-call compile is attributed by its own compile event;
-            # recording it here too would poison the step p95/max
-            timer.stop(shape=shape, record=not train_step.last_first_call)
-        pending.append(metrics)
-        steps += 1
-        if len(pending) >= max(check_every, 1):
-            t_flush = (time.perf_counter() if telemetry is not None else 0.0)
-            loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum,
-                                            check_finite, epoch, steps,
-                                            health=health,
-                                            collect=telemetry is not None)
-            pending = []
+    try:
+        for dev_batch in it:
+            shape = tuple(dev_batch["image"].shape)
+            shapes.add(shape)
             if telemetry is not None:
-                win_samples = timer.drain_window()
-                if health is not None:
-                    health.on_window(win_samples, epoch=epoch, phase="train")
-                w0 = t_window
-                t_window = _emit_step_window(
-                    telemetry, win_samples,
-                    steps=steps - flushed_steps, phase="train",
-                    epoch=epoch, t_window=t_window,
-                    images=img_sum - flushed_img, **win)
-                if spans is not None:
-                    spans.emit(trace_id=trace_id, name="steps", start=w0,
-                               end=t_flush, parent_id=root_id, step=steps,
-                               steps=steps - flushed_steps)
-                    spans.emit(trace_id=trace_id, name="metric_flush",
-                               start=t_flush, end=t_window,
-                               parent_id=root_id, step=steps)
-                flushed_img = img_sum
-                flushed_steps = steps
-            if show_progress and hasattr(it, "set_postfix") and img_sum:
-                it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
-    t_flush = (time.perf_counter() if telemetry is not None else 0.0)
-    loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum, check_finite,
-                                    epoch, steps, health=health,
-                                    collect=telemetry is not None)
+                telemetry.step_tick()
+                timer.start()
+            state, metrics = train_step(state, dev_batch)
+            if telemetry is not None:
+                # a first-call compile is attributed by its own compile
+                # event; recording it here too would poison the step
+                # p95/max
+                timer.stop(shape=shape,
+                           record=not train_step.last_first_call)
+            pending.append(metrics)
+            steps += 1
+            if len(pending) >= max(check_every, 1):
+                t_flush = (time.perf_counter()
+                           if telemetry is not None else 0.0)
+                loss_sum, img_sum, win = _flush(
+                    pending, loss_sum, img_sum, check_finite, epoch, steps,
+                    health=health, collect=telemetry is not None)
+                pending = []
+                if telemetry is not None:
+                    win_samples = timer.drain_window()
+                    if health is not None:
+                        health.on_window(win_samples, epoch=epoch,
+                                         phase="train")
+                    w0 = t_window
+                    t_window = _emit_step_window(
+                        telemetry, win_samples,
+                        steps=steps - flushed_steps, phase="train",
+                        epoch=epoch, t_window=t_window,
+                        images=img_sum - flushed_img, **win)
+                    if spans is not None:
+                        spans.emit(trace_id=trace_id, name="steps",
+                                   start=w0, end=t_flush,
+                                   parent_id=root_id, step=steps,
+                                   steps=steps - flushed_steps)
+                        spans.emit(trace_id=trace_id, name="metric_flush",
+                                   start=t_flush, end=t_window,
+                                   parent_id=root_id, step=steps)
+                    flushed_img = img_sum
+                    flushed_steps = steps
+                if show_progress and hasattr(it, "set_postfix") and img_sum:
+                    it.set_postfix(loss=f"{loss_sum / img_sum:.4f}")
+        t_flush = (time.perf_counter() if telemetry is not None else 0.0)
+        loss_sum, img_sum, win = _flush(pending, loss_sum, img_sum,
+                                        check_finite, epoch, steps,
+                                        health=health,
+                                        collect=telemetry is not None)
+    except Exception as e:
+        # the incident hook (a crashed loader thread, a poisoned batch,
+        # an XLA error): bundle first, THEN unwind — the NaN abort path
+        # is excluded inside (its bundle rode the health.alert)
+        _notify_incident(telemetry, e, phase="train", epoch=epoch,
+                         step=steps)
+        raise
     seconds = time.perf_counter() - t0
     if telemetry is not None:
         tail = timer.drain_window()
@@ -386,25 +414,33 @@ def evaluate(eval_step: Callable, params, batches: Iterable, *,
                                          epoch=0, t_window=t_window,
                                          images=n_seen - n_before)
 
-    for dev_batch in it:
-        # don't fetch per step: each device_get is a host<->device round
-        # trip (expensive on pods/tunnels) and drains the dispatch queue.
-        # Windowed instead (like train_one_epoch): one sync per
-        # ``check_every`` batches.  The window (plus prefetch depth) also
-        # caps how many in-flight INPUT batches the dispatch queue can pin
-        # in HBM, so the default stays small (4) — at UCF-QNRF image sizes
-        # each staged batch is hundreds of MB; raise it for small-image
-        # evals where the round trips dominate.
-        shape = tuple(dev_batch["image"].shape)
-        if telemetry is not None:
-            telemetry.step_tick()
-            timer.start()
-        pending.append(eval_step(params, dev_batch, batch_stats))
-        if telemetry is not None:
-            timer.stop(shape=shape, record=not eval_step.last_first_call)
-        if len(pending) >= max(check_every, 1):
-            flush()
-    flush()
+    try:
+        for dev_batch in it:
+            # don't fetch per step: each device_get is a host<->device
+            # round trip (expensive on pods/tunnels) and drains the
+            # dispatch queue.  Windowed instead (like train_one_epoch):
+            # one sync per ``check_every`` batches.  The window (plus
+            # prefetch depth) also caps how many in-flight INPUT batches
+            # the dispatch queue can pin in HBM, so the default stays
+            # small (4) — at UCF-QNRF image sizes each staged batch is
+            # hundreds of MB; raise it for small-image evals where the
+            # round trips dominate.
+            shape = tuple(dev_batch["image"].shape)
+            if telemetry is not None:
+                telemetry.step_tick()
+                timer.start()
+            pending.append(eval_step(params, dev_batch, batch_stats))
+            if telemetry is not None:
+                timer.stop(shape=shape,
+                           record=not eval_step.last_first_call)
+            if len(pending) >= max(check_every, 1):
+                flush()
+        flush()
+    except Exception as e:
+        # same incident hook as the train loop (see _notify_incident)
+        _notify_incident(telemetry, e, phase="eval", epoch=0,
+                         step=len(pending))
+        raise
     if telemetry is not None:
         _emit_epoch_telemetry(telemetry, timer, stall, phase="eval",
                               epoch=0, seconds=time.perf_counter() - t0)

@@ -25,6 +25,16 @@
 #       spread floors) and throughput (req/s, gated downward), for
 #       f32/bf16/int8 through the full 2-replica fleet stack
 #
+#   CI_BENCH_ONLY=slo tools/ci_bench_gate.sh
+#       gates the SLO layer: tools/slo_report.py grades the committed
+#       fleet-bench-era telemetry fixture (SLO_FIXTURE_cpu_r12.jsonl)
+#       against the committed example spec (slo_spec.json) — exit 1 if
+#       the spec/fixture pair drifts into violation, exit 2 if either
+#       artifact is broken.  Compare-only by construction: the report
+#       writes nothing, so there is no baseline-overwrite trap to route
+#       around (unlike the perf/bn/fleet tiers below).
+#       CI_SLO_FIXTURE / CI_SLO_SPEC override the pair.
+#
 # Environment knobs:
 #   CI_BENCH_OUT           where the fresh run's records land
 #                          (default /tmp/ci_bench_suite.jsonl)
@@ -43,6 +53,15 @@ set -eu
 BASELINE=${1:-BENCH_SUITE_r07.json}
 OUT=${CI_BENCH_OUT:-/tmp/ci_bench_suite.jsonl}
 ONLY=${CI_BENCH_ONLY:-host}
+
+# the slo tier never runs the bench suite: it replays the committed
+# telemetry fixture through the burn-rate engine and exits on its verdict
+if [ "$ONLY" = "slo" ]; then
+    cd "$(dirname "$0")/.."
+    exec python tools/slo_report.py \
+        "${CI_SLO_FIXTURE:-SLO_FIXTURE_cpu_r12.jsonl}" \
+        --spec "${CI_SLO_SPEC:-slo_spec.json}"
+fi
 
 # the fleet tier pins one device per replica; on the CPU gate box that
 # means the 8-virtual-device smoke mesh (a 1-device run would refuse

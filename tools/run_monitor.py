@@ -22,11 +22,18 @@ Detection:
   Restarted processes are distinguished from resumed streams by the
   heartbeat payload's ``start_ts``/``seq`` (obs/sources.py).
 * alerts — ``health.alert`` rollup per host, by ``signal/alert`` kind.
+* incidents — per-host incident bundles (``obs/incidents.py`` dumps
+  them under each host's ``--incident-dir``; point this tool at a run
+  dir holding them, at any nesting the patterns below cover) are
+  collected and CORRELATED into one fleet-level timeline: bundles whose
+  trigger times fall within ``--incident-window-s`` of each other are
+  one cluster — "host 2's NaN and host 5's quarantine were the same
+  event" is the answer a post-mortem actually needs.
 
 Pure host-side file reading — no JAX import, safe on any machine the
 artifacts were copied to (same contract as tools/telemetry_report.py).
-Exit code: 0 healthy, 1 when any straggler/dead host/alert is found
-(one-shot mode), so a babysitter script can page on it.
+Exit code: 0 healthy, 1 when any straggler/dead host/alert/incident is
+found (one-shot mode), so a babysitter script can page on it.
 """
 
 from __future__ import annotations
@@ -42,9 +49,62 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from can_tpu.obs.incidents import MANIFEST_NAME, read_manifest  # noqa: E402
 from can_tpu.obs.report import read_events_counted  # noqa: E402
 
 _HOST_RE = re.compile(r"telemetry\.host(\d+)\.jsonl$")
+
+# where bundles live relative to a run dir: beside the telemetry files,
+# under the conventional incidents/ subdir, or one directory down (a
+# per-host collection layout: <run>/<host>/incident-*)
+_INCIDENT_PATTERNS = ("incident-*", os.path.join("incidents", "incident-*"),
+                      os.path.join("*", "incident-*"))
+
+
+def discover_incidents(run_dir: str) -> list:
+    """Every valid incident bundle reachable from ``run_dir``, as
+    manifest dicts (+ ``path``), sorted by trigger time.  A directory
+    without a readable manifest is a torn dump (killed mid-write) and is
+    skipped — the manifest-last contract makes that the correct read."""
+    seen = set()
+    out = []
+    for pat in _INCIDENT_PATTERNS:
+        for bundle in glob.glob(os.path.join(run_dir, pat)):
+            bundle = os.path.normpath(bundle)
+            if bundle in seen or not os.path.isdir(bundle):
+                continue
+            seen.add(bundle)
+            m = read_manifest(bundle)
+            if m is None:
+                continue
+            m["path"] = bundle
+            out.append(m)
+    return sorted(out, key=lambda m: (m.get("ts") or 0.0, m["path"]))
+
+
+def correlate_incidents(incidents: list, *,
+                        window_s: float = 30.0) -> list:
+    """Cluster a ts-sorted bundle list into fleet-level incidents: a
+    bundle within ``window_s`` of the cluster's LATEST member joins it
+    (chained — an alert cascading host to host stays one incident).
+    Each cluster: t0/t1, the hosts involved, the reasons seen."""
+    clusters = []
+    for m in incidents:
+        ts = m.get("ts") or 0.0
+        if clusters and ts - clusters[-1]["t1"] <= window_s:
+            c = clusters[-1]
+            c["t1"] = max(c["t1"], ts)
+        else:
+            c = {"t0": ts, "t1": ts, "hosts": set(), "reasons": {},
+                 "bundles": 0}
+            clusters.append(c)
+        c["hosts"].add(m.get("host_id", "?"))
+        reason = str(m.get("reason", "?"))
+        c["reasons"][reason] = c["reasons"].get(reason, 0) + 1
+        c["bundles"] += 1
+    return [{**c, "hosts": sorted(c["hosts"]),
+             "reasons": dict(sorted(c["reasons"].items()))}
+            for c in clusters]
 
 
 def discover_hosts(run_dir: str) -> dict:
@@ -156,8 +216,28 @@ def analyze_run(host_stats: dict, *, now=None, stale_after_s: float = 180.0,
     }
 
 
+def attach_incidents(run: dict, run_dir: str, *,
+                     incident_window_s: float = 30.0) -> dict:
+    """Fold the run dir's incident bundles + their fleet-level
+    correlation into an ``analyze_run`` verdict (any bundle makes the
+    run unhealthy — a bundle IS a recorded failure)."""
+    incidents = discover_incidents(run_dir)
+    run["incidents"] = [{"ts": m.get("ts"),
+                         "host_id": m.get("host_id", "?"),
+                         "reason": m.get("reason", "?"),
+                         "severity": m.get("severity", "?"),
+                         "ring_events": m.get("ring_events"),
+                         "path": m["path"]}
+                        for m in incidents]
+    run["incident_clusters"] = correlate_incidents(
+        incidents, window_s=incident_window_s)
+    run["ok"] = run["ok"] and not incidents
+    return run
+
+
 def analyze_dir(run_dir: str, *, now=None, stale_after_s: float = 180.0,
-                skew_factor: float = 1.5, recent_windows: int = 8) -> dict:
+                skew_factor: float = 1.5, recent_windows: int = 8,
+                incident_window_s: float = 30.0) -> dict:
     hosts = discover_hosts(run_dir)
     if not hosts:
         raise SystemExit(f"no telemetry.host*.jsonl files in {run_dir}")
@@ -167,8 +247,10 @@ def analyze_dir(run_dir: str, *, now=None, stale_after_s: float = 180.0,
         stats[hid] = analyze_host(events, skipped=skipped,
                                   recent_windows=recent_windows)
         stats[hid]["path"] = path
-    return analyze_run(stats, now=now, stale_after_s=stale_after_s,
-                       skew_factor=skew_factor)
+    run = analyze_run(stats, now=now, stale_after_s=stale_after_s,
+                      skew_factor=skew_factor)
+    return attach_incidents(run, run_dir,
+                            incident_window_s=incident_window_s)
 
 
 class HostTail:
@@ -211,11 +293,14 @@ class HostTail:
 
 
 def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
-               skew_factor: float, recent_windows: int):
+               skew_factor: float, recent_windows: int,
+               incident_window_s: float = 30.0):
     """One --follow poll: discover hosts (new ones can appear as a pod
     spins up), advance each tail incrementally, analyze.  Returns None
     while the dir has no telemetry files yet — the watch waits for the
-    run instead of dying before it starts."""
+    run instead of dying before it starts.  Incident bundles are
+    re-discovered each poll (they appear exactly when things go wrong —
+    the status line is where an operator should see them first)."""
     hosts = discover_hosts(run_dir)
     if not hosts:
         return None
@@ -228,8 +313,10 @@ def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
         stats[hid] = analyze_host(tail.events, skipped=tail.skipped,
                                   recent_windows=recent_windows)
         stats[hid]["path"] = path
-    return analyze_run(stats, now=time.time(),
-                       stale_after_s=stale_after_s, skew_factor=skew_factor)
+    run = analyze_run(stats, now=time.time(),
+                      stale_after_s=stale_after_s, skew_factor=skew_factor)
+    return attach_incidents(run, run_dir,
+                            incident_window_s=incident_window_s)
 
 
 def _fmt_s(v) -> str:
@@ -264,6 +351,21 @@ def format_report(run: dict) -> str:
     if run["dead"]:
         lines.append(f"dead hosts: {run['dead']} (no heartbeat within "
                      f"the staleness bound)")
+    incidents = run.get("incidents") or []
+    if incidents:
+        lines.append(f"incident timeline ({len(incidents)} bundle(s), "
+                     f"{len(run.get('incident_clusters') or [])} "
+                     f"correlated incident(s)):")
+        for i, c in enumerate(run.get("incident_clusters") or []):
+            span = c["t1"] - c["t0"]
+            lines.append(
+                f"  incident {i}: hosts {c['hosts']} "
+                f"reasons " + " ".join(f"{k}x{n}"
+                                       for k, n in c["reasons"].items())
+                + f" ({c['bundles']} bundle(s) over {span:.1f}s)")
+        for m in incidents:
+            lines.append(f"    [{m['ts']:.3f}] host {m['host_id']} "
+                         f"{m['reason']} ({m['severity']}) -> {m['path']}")
     return "\n".join(lines)
 
 
@@ -278,7 +380,8 @@ def format_status_line(run: dict) -> str:
             f"slowest_p50={pace} "
             f"stragglers={run['stragglers'] or '-'} "
             f"dead={run['dead'] or '-'} "
-            f"alerts={run['alerts_total']}")
+            f"alerts={run['alerts_total']} "
+            f"incidents={len(run.get('incidents') or [])}")
 
 
 def main(argv=None) -> int:
@@ -297,12 +400,16 @@ def main(argv=None) -> int:
                         "the fastest host flags a straggler")
     p.add_argument("--recent-windows", type=int, default=8,
                    help="step_window events pooled for the recent pace")
+    p.add_argument("--incident-window-s", type=float, default=30.0,
+                   help="bundles whose trigger times chain within this "
+                        "window correlate into one fleet-level incident")
     p.add_argument("--json", action="store_true",
                    help="emit the analysis dict as JSON (one-shot mode)")
     args = p.parse_args(argv)
     kw = dict(stale_after_s=args.stale_after_s,
               skew_factor=args.skew_factor,
-              recent_windows=args.recent_windows)
+              recent_windows=args.recent_windows,
+              incident_window_s=args.incident_window_s)
     if args.follow:
         tails: dict = {}
         waiting = False

@@ -9,6 +9,9 @@ once converted to the trace-event format::
     python tools/trace_export.py runs/exp1/telemetry.host0.jsonl
     python tools/trace_export.py runs/exp1/ --out run.trace.json
     python tools/trace_export.py tel.jsonl --trace-id req-1f03-7
+    python tools/trace_export.py runs/exp1/incidents/incident-...-h0-.../
+        # an incident bundle's ring dump (obs/incidents.py) exports the
+        # same way — quarantine to flame view, one artifact
 
 Mapping: every span becomes one complete event (``ph: "X"``) with
 microsecond ``ts``/``dur`` normalised to each HOST's earliest span (spans
@@ -35,6 +38,11 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from can_tpu.obs.incidents import (  # noqa: E402
+    MANIFEST_NAME,
+    bundle_ring_path,
+    is_bundle_dir,
+)
 from can_tpu.obs.report import read_events_counted  # noqa: E402
 
 _SPAN_KEYS = ("trace_id", "span_id", "parent_id", "name",
@@ -99,10 +107,19 @@ def spans_to_trace_events(events, *, trace_id: Optional[str] = None) -> dict:
 
 def resolve_paths(target: str) -> list:
     if os.path.isdir(target):
+        # an incident bundle (obs/incidents.py) IS a telemetry source:
+        # its ring dump uses the bus schema, so "replica quarantined" ->
+        # flame view is one command on one artifact
+        if is_bundle_dir(target):
+            try:
+                return [bundle_ring_path(target)]
+            except ValueError as e:
+                raise SystemExit(str(e))
         paths = sorted(glob.glob(os.path.join(target,
                                               "telemetry.host*.jsonl")))
         if not paths:
-            raise SystemExit(f"no telemetry.host*.jsonl files in {target}")
+            raise SystemExit(f"no telemetry.host*.jsonl files (or an "
+                             f"{MANIFEST_NAME} bundle) in {target}")
         return paths
     if not os.path.isfile(target):
         raise SystemExit(f"no such file or directory: {target}")
