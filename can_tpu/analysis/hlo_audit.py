@@ -1,7 +1,9 @@
 """Program-contract auditor: structured invariants over lowered StableHLO.
 
-The stack ships eight compiled program families (default / bf16 / syncBN
-train steps, the eval step, and the f32/bf16/int8 serve predicts) whose
+The stack ships ten compiled program families (default / bf16 / syncBN
+train steps — the syncBN pair on both the full 2x4 mesh and the elastic
+dp′=1 shrunk mesh — the eval step, and the f32/bf16/int8 serve predicts)
+whose
 correctness-critical STRUCTURE — how many collectives, what operand
 shapes, which dtypes, whether params live quantized in HBM — used to be
 guarded by scattered per-test regexes.  This module lowers each canonical
@@ -66,6 +68,12 @@ DEFAULT_UPDATE_OUT = "PROGRAM_CONTRACTS_local.json"
 # (h % (8*sp) == 0 and >= 2 feature rows per shard)
 AUDIT_HW = (64, 64)
 AUDIT_DP, AUDIT_SP = 2, 4
+# the RE-FORMED mesh after an elastic shrink loses half the pod
+# (parallel/elastic.py): dp 2 -> 1 at the same sp.  The dp′ programs are
+# contracted exactly like the full-mesh ones, so an elastic transition
+# cannot silently change the compiled program's collective structure —
+# the re-formed world's psums/packing are pinned, not assumed.
+AUDIT_DP_SHRUNK = 1
 
 
 class AuditError(Exception):
@@ -323,19 +331,24 @@ def _lower_train_default(compute_dtype=None):
     return step.lower(state, _audit_batch(1))
 
 
-def _lower_sp_syncbn(impl: str):
+def _lower_sp_syncbn(impl: str, dp: int = AUDIT_DP):
+    """The dp x sp syncBN train step.  ``dp=AUDIT_DP_SHRUNK`` lowers the
+    program an elastic shrink RE-FORMS (same sp, half the pod, lr peak
+    follows the linear rule) — audited under its own contract entry so
+    the transition's collective structure is an invariant, not an
+    accident."""
     from can_tpu.ops.bn_moments import make_bn_ops
     from can_tpu.parallel.mesh import make_mesh
     from can_tpu.parallel.spatial import make_sp_train_step
     from can_tpu.train import make_lr_schedule, make_optimizer
 
-    devs = _ensure_devices(AUDIT_DP * AUDIT_SP)
-    mesh = make_mesh(devs[:AUDIT_DP * AUDIT_SP], dp=AUDIT_DP, sp=AUDIT_SP)
-    opt = make_optimizer(make_lr_schedule(1e-3, world_size=AUDIT_DP))
+    devs = _ensure_devices(dp * AUDIT_SP)
+    mesh = make_mesh(devs[:dp * AUDIT_SP], dp=dp, sp=AUDIT_SP)
+    opt = make_optimizer(make_lr_schedule(1e-3, world_size=dp))
     _, _, state = _train_setup(batch_norm=True)
     step = make_sp_train_step(opt, mesh, AUDIT_HW, donate=False,
                               bn_ops=make_bn_ops(impl))
-    return step.lower(state, _audit_batch(AUDIT_DP))
+    return step.lower(state, _audit_batch(dp))
 
 
 def _lower_eval():
@@ -384,6 +397,12 @@ PROGRAM_BUILDERS = {
     "train_step_bf16": lambda: _lower_train_default("bfloat16"),
     "train_step_syncbn_onepass": lambda: _lower_sp_syncbn("onepass"),
     "train_step_syncbn_twopass": lambda: _lower_sp_syncbn("twopass"),
+    # the elastic dp′ mesh (shrink 2x4 -> 1x4): the programs training
+    # resumes on after losing half the pod
+    "train_step_syncbn_onepass_dp1": lambda: _lower_sp_syncbn(
+        "onepass", dp=AUDIT_DP_SHRUNK),
+    "train_step_syncbn_twopass_dp1": lambda: _lower_sp_syncbn(
+        "twopass", dp=AUDIT_DP_SHRUNK),
     "eval_step_f32": _lower_eval,
     "serve_predict_f32": lambda: _lower_serve("f32"),
     "serve_predict_bf16": lambda: _lower_serve("bf16"),

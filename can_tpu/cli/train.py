@@ -271,6 +271,24 @@ def parse_args(argv=None):
                         "(area*slots + launch_cost*launches, HBM cap "
                         "respected); 'legacy' is the pre-r8 heuristic "
                         "planner, kept for A/B comparison")
+    p.add_argument("--elastic-dir", type=str, default="",
+                   help="arm elastic shrink-and-continue training "
+                        "(parallel/elastic.py): a shared signal directory "
+                        "(shared FS on a pod) polled for preemption "
+                        "leave/dead files — written by a preempted host's "
+                        "SIGTERM hook or tools/run_monitor.py "
+                        "--emit-signal.  On an agreed signal, all hosts "
+                        "checkpoint at a bounded barrier, leavers exit "
+                        "cleanly, survivors re-rendezvous at the shrunk "
+                        "world, the planner replans the interrupted "
+                        "epoch's remaining items, lr/global-batch rescale "
+                        "with dp, and training continues — recorded as "
+                        "one elastic.transition telemetry event.  "
+                        "Default off: no hook, no per-step polling")
+    p.add_argument("--elastic-check-every", type=int, default=4,
+                   help="steps between elastic agreement polls (each is "
+                        "one small host allgather at world > 1; smaller "
+                        "reacts faster, larger costs less)")
     p.add_argument("--compile-cache", type=str, default="auto",
                    help="persistent XLA compilation-cache dir ('auto' = "
                         "~/.cache/can_tpu/xla, 'off' disables): warm "
@@ -446,6 +464,8 @@ def main(argv=None) -> int:
         raise SystemExit("--eval-interval must be >= 1 (the final epoch "
                          "always evaluates; large values approximate "
                          "'rarely')")
+    if args.elastic_check_every < 1:
+        raise SystemExit("--elastic-check-every must be >= 1")
     train_img, train_gt = resolve_split_roots(
         "train", args.train_image_root, args.train_gt_root, args.data_root)
     test_img, test_gt = resolve_split_roots(
@@ -482,13 +502,25 @@ def main(argv=None) -> int:
     )
 
     if args.init_checkpoint:
+        from can_tpu.parallel.elastic import load_manifest as _el_manifest
+
         saved_cfg = load_run_config(args.init_checkpoint)
         # guard only REAL resumes: a config with no checkpoint beside it
         # (a run that crashed before its first save) cold-starts, and a
-        # cold start has no restored schedule to protect
-        if saved_cfg is not None and has_checkpoint(args.init_checkpoint):
+        # cold start has no restored schedule to protect.  A preemption
+        # BEFORE the first epoch save leaves no integer step dir but DOES
+        # leave an elastic manifest + shrink checkpoint — that mid-epoch
+        # state's schedule needs the guard every bit as much (elastic is
+        # a world change, never a licence for schedule drift).
+        # world_size itself is checked POST-init (dp is unknown before
+        # devices exist) with the elastic allowance — strip it here
+        resumable = (has_checkpoint(args.init_checkpoint)
+                     or _el_manifest(args.init_checkpoint) is not None)
+        if saved_cfg is not None and resumable:
+            sched_cfg = {k: v for k, v in saved_cfg.items()
+                         if k != "world_size"}
             try:
-                drifted = check_resume_config(saved_cfg, run_cfg,
+                drifted = check_resume_config(sched_cfg, run_cfg,
                                               allow=args.allow_config_change)
             except ConfigDriftError as e:
                 raise SystemExit(f"{e} (pass --allow-config-change to "
@@ -507,47 +539,60 @@ def main(argv=None) -> int:
                       or args.incident_dir or args.slo_spec)
     apply_platform(args)
     topo = init_runtime()
-    main_proc = is_main_process()
+    # the elastic supervisor's SIGTERM hook: installed AFTER init_runtime
+    # (jax.distributed.initialize registers XLA's own preemption notifier
+    # at initialize, clobbering handlers installed earlier) and BEFORE
+    # the incident manager's (build_telemetry, inside the generation
+    # loop): the manager then dumps the preemption bundle FIRST and
+    # chains here — which sets the leaving flag and RETURNS, spending the
+    # grace window on the shrink choreography instead of exiting
+    # mid-collective
+    supervisor = None
+    if args.elastic_dir:
+        from can_tpu.parallel.elastic import ElasticSupervisor
+
+        supervisor = ElasticSupervisor(
+            args.elastic_dir, check_every=args.elastic_check_every)
+        supervisor.install_signal_hook()
     apply_compile_cache(args, announce=is_main_process())
-    if main_proc:
+    if is_main_process():
         print(f"[runtime] {topo}")
         print(f"[start] {datetime.datetime.now():%Y-%m-%d %H:%M:%S}")
-    if args.syncBN and main_proc:
-        print("[model] BatchNorm variant; stats sync across replicas via "
-              f"global-batch reductions (moments path: {args.bn_impl})")
+        if args.syncBN:
+            print("[model] BatchNorm variant; stats sync across replicas "
+                  f"via global-batch reductions (moments path: "
+                  f"{args.bn_impl})")
+    return _run_elastic_generations(
+        args, run_cfg, topo, supervisor=supervisor,
+        trace_window=trace_window, instrument=instrument,
+        split_roots=(train_img, train_gt, test_img, test_gt),
+        save_run_config=save_run_config,
+        check_resume_config=check_resume_config)
 
-    mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
-    # SyncBN moments path (ops/bn_moments.py): built only for --syncBN so
-    # a default run constructs nothing new — its lowered step must stay
-    # byte-identical (tests/test_batchnorm.py pins this)
-    bn_ops = None
-    if args.syncBN:
-        from can_tpu.ops.bn_moments import make_bn_ops
 
-        if args.bn_impl == "pallas":
-            if args.sp == 1 and dp > 1:
-                # pallas_call has no GSPMD partitioning rule: under the
-                # jit-sharded dp step it would force a gather; inside the
-                # sp shard_map body (per-device blocks) it composes fine
-                raise SystemExit("--bn-impl pallas needs --sp > 1 (the "
-                                 "kernel runs per-device inside shard_map) "
-                                 "or a single device; use onepass for the "
-                                 "GSPMD data-parallel step")
-            bn_ops = make_bn_ops("pallas",
-                                 interpret=jax.default_backend() != "tpu")
-        else:
-            bn_ops = make_bn_ops(args.bn_impl)
+def _run_elastic_generations(args, run_cfg, topo, *, supervisor,
+                             trace_window, instrument, split_roots,
+                             save_run_config, check_resume_config) -> int:
+    """The generation loop: build the world, train; on an agreed elastic
+    shrink, checkpoint + tear down + re-rendezvous and loop — every
+    iteration is one runtime generation (parallel/runtime.py).  The
+    telemetry stack and datasets are built once and survive transitions;
+    everything device-bound (mesh, steps, batchers, state) is rebuilt
+    per generation.  Pre-elastic runs execute exactly one iteration."""
+    from can_tpu.parallel import elastic as el
+    from can_tpu.utils.checkpoint import CheckpointIOError, ConfigDriftError
+
+    train_img, train_gt, test_img, test_gt = split_roots
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     pad_multiple, min_pad, min_bucket_h = resolve_sp_padding(
         args.pad_multiple, args.sp)
-    if args.sp > 1 and main_proc and pad_multiple != "auto":
-        print(f"[data] sp={args.sp}: padding H,W to multiples of {pad_multiple}")
 
     from can_tpu.cli.common import split_prepared_spec
     from can_tpu.data import ItemCache, StaleStoreError
 
-    # one cache across both splits (keys carry the dataset root): the
-    # budget is a single host-RAM promise, not one per split
+    # datasets + item cache are world-INDEPENDENT (host-side decode):
+    # built once, they survive elastic transitions — only device-bound
+    # objects rebuild per generation
     item_cache = (ItemCache(int(args.item_cache_mb * 1e6))
                   if args.item_cache_mb > 0 else None)
     try:
@@ -564,299 +609,532 @@ def main(argv=None) -> int:
     except StaleStoreError as e:
         raise SystemExit(f"--prepared-root {args.prepared_root}: {e}")
     num_workers = resolve_num_workers(args)
-    import math as _math
 
-    # legal remnant sub-batch sizes must split evenly across hosts AND
-    # across the mesh's dp axis (make_global_batch shards the leading dim)
-    quantum = _math.lcm(dp, process_count())
-    common = dict(seed=args.seed, process_index=process_index(),
-                  process_count=process_count(), pad_multiple=pad_multiple,
-                  min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
-                  num_workers=num_workers, max_buckets=args.max_buckets,
-                  remnant_sizes=not args.no_remnant_batches,
-                  batch_quantum=quantum, plan_mode=args.plan_mode,
-                  launch_cost_px=resolve_launch_cost_px(
-                      args.launch_cost_mpx, announce=main_proc))
-    # HBM agreed across hosts (min) ONCE: both the launch cap and the remat
-    # policy must be identical on every host or the lockstep schedule
-    # deadlocks (ADVICE r4 high — rank>0 reading a non-addressable device's
-    # stats used to silently get None while rank 0 got a cap)
-    from can_tpu.cli.common import agreed_device_memory_bytes
+    # cross-generation context: the telemetry stack is built by the FIRST
+    # generation and survives transitions (the elastic.transition event
+    # rides the same bus as everything else); pending_manifest hands the
+    # shrink record from the dying generation to the next iteration
+    ctx = {"telemetry": None, "heartbeat": None, "exporter": None,
+           "logger": None, "pending_manifest": None, "best_mae": None,
+           "generations": 0}
 
-    hbm = agreed_device_memory_bytes()
-    ndev = dp * args.sp  # devices per launch: batch shards over dp, H over sp
-    if not args.no_remnant_batches:
-        # HBM cap per launch: bucket cells too big for the full global
-        # batch run at a smaller menu size instead of OOMing (train only —
-        # eval has no backward, so the test batcher stays uncapped)
-        from can_tpu.cli.common import max_launch_pixels
+    def run_generation():
+        """One runtime generation: build the world at the CURRENT
+        process_count/device set, (elastic-)resume, train.  Returns
+        ("done"|"abort", rc) or ("reform", None) or ("leave", rc)."""
+        from can_tpu.utils.checkpoint import has_checkpoint, load_run_config
 
-        train_common = dict(common,
-                            max_launch_px=max_launch_pixels(
-                                bf16=args.bf16, hbm_bytes=hbm, shards=ndev))
-    else:
-        train_common = common
-    train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True,
-                                   **train_common)
-    test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False, **common)
-    if main_proc:
-        print(f"[data] train={len(train_ds)} test={len(test_ds)} "
-              f"host_batch={host_batch} dp={dp} sp={args.sp} "
-              f"workers={num_workers}")
-        # compile-count telemetry: every distinct bucket shape compiles its
-        # own executable, so this number is the first-epoch compile bill
-        for tag, b in (("train", train_batcher), ("test", test_batcher)):
-            n = b.distinct_shapes(0)
-            print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
-                  f"{n} distinct batch shapes, "
-                  f"{b.program_count(0)} (shape x size) programs "
-                  f"(plan={b.plan_mode}, "
-                  f"padding overhead {b.padding_overhead():.1%}, "
-                  f"schedule overhead {b.schedule_overhead(0):.1%})")
-            if n > 4 * b.max_buckets:
-                print(f"[data] WARNING: {n} shapes will each compile a "
-                      f"program; use --pad-multiple auto to bound this")
+        ctx["generations"] += 1
+        first_gen = ctx["generations"] == 1
+        main_proc = is_main_process()
+        mesh, host_batch, dp = build_mesh_and_batch(args.batch_size, args.sp)
+        # SyncBN moments path (ops/bn_moments.py): built only for
+        # --syncBN so a default run constructs nothing new — its lowered
+        # step must stay byte-identical (tests/test_batchnorm.py)
+        bn_ops = None
+        if args.syncBN:
+            from can_tpu.ops.bn_moments import make_bn_ops
 
-    # identical init on every host by construction: same seed, same key
-    params = cannet_init(jax.random.key(args.seed), batch_norm=args.syncBN)
-    if args.vgg16_npz:
-        params = load_vgg16_frontend(params, args.vgg16_npz)
+            if args.bn_impl == "pallas":
+                if args.sp == 1 and dp > 1:
+                    # pallas_call has no GSPMD partitioning rule: under
+                    # the jit-sharded dp step it would force a gather;
+                    # inside the sp shard_map body it composes fine
+                    raise SystemExit(
+                        "--bn-impl pallas needs --sp > 1 (the kernel "
+                        "runs per-device inside shard_map) or a single "
+                        "device; use onepass for the GSPMD data-parallel "
+                        "step")
+                bn_ops = make_bn_ops("pallas",
+                                     interpret=jax.default_backend() != "tpu")
+            else:
+                bn_ops = make_bn_ops(args.bn_impl)
+        if args.sp > 1 and main_proc and first_gen and pad_multiple != "auto":
+            print(f"[data] sp={args.sp}: padding H,W to multiples of "
+                  f"{pad_multiple}")
+        import math as _math
+
+        # legal remnant sub-batch sizes must split evenly across hosts
+        # AND across the mesh's dp axis (make_global_batch shards the
+        # leading dim).  The quantum is a property of THIS generation's
+        # world: after a shrink the planner replans under the new one.
+        quantum = _math.lcm(dp, process_count())
+        common = dict(seed=args.seed, process_index=process_index(),
+                      process_count=process_count(),
+                      pad_multiple=pad_multiple,
+                      min_pad_multiple=min_pad, min_bucket_h=min_bucket_h,
+                      num_workers=num_workers, max_buckets=args.max_buckets,
+                      remnant_sizes=not args.no_remnant_batches,
+                      batch_quantum=quantum, plan_mode=args.plan_mode,
+                      launch_cost_px=resolve_launch_cost_px(
+                          args.launch_cost_mpx,
+                          announce=main_proc and first_gen))
+        # HBM agreed across hosts (min) ONCE PER GENERATION: both the
+        # launch cap and the remat policy must be identical on every host
+        # or the lockstep schedule deadlocks (ADVICE r4)
+        from can_tpu.cli.common import agreed_device_memory_bytes
+
+        hbm = agreed_device_memory_bytes()
+        ndev = dp * args.sp  # devices per launch
+        if not args.no_remnant_batches:
+            # HBM cap per launch: bucket cells too big for the full
+            # global batch run at a smaller menu size instead of OOMing
+            from can_tpu.cli.common import max_launch_pixels
+
+            train_common = dict(common,
+                                max_launch_px=max_launch_pixels(
+                                    bf16=args.bf16, hbm_bytes=hbm,
+                                    shards=ndev))
+        else:
+            train_common = common
+        train_batcher = ShardedBatcher(train_ds, host_batch, shuffle=True,
+                                       **train_common)
+        test_batcher = ShardedBatcher(test_ds, host_batch, shuffle=False,
+                                      **common)
         if main_proc:
-            print(f"[init] loaded pretrained VGG-16 frontend from {args.vgg16_npz}")
-    if args.init_torch_pth:
-        # the reference's .pth warm-start (its train.py:98-102 resumes
-        # model-only with strict=False; here the layout check is strict) —
-        # params from the torch checkpoint, optimizer/step fresh.
-        # Deterministic file read on every host => identical init holds.
-        from can_tpu.utils.torch_import import load_torch_checkpoint
+            print(f"[data] train={len(train_ds)} test={len(test_ds)} "
+                  f"host_batch={host_batch} dp={dp} sp={args.sp} "
+                  f"workers={num_workers}")
+            # compile-count telemetry: every distinct bucket shape
+            # compiles its own executable — the first-epoch compile bill
+            for tag, b in (("train", train_batcher), ("test", test_batcher)):
+                n = b.distinct_shapes(0)
+                print(f"[data] {tag}: buckets={b.describe_buckets()} -> "
+                      f"{n} distinct batch shapes, "
+                      f"{b.program_count(0)} (shape x size) programs "
+                      f"(plan={b.plan_mode}, "
+                      f"padding overhead {b.padding_overhead():.1%}, "
+                      f"schedule overhead {b.schedule_overhead(0):.1%})")
+                if n > 4 * b.max_buckets:
+                    print(f"[data] WARNING: {n} shapes will each compile "
+                          f"a program; use --pad-multiple auto to bound "
+                          f"this")
 
-        params = load_torch_checkpoint(args.init_torch_pth)
-        if main_proc:
-            print(f"[init] warm-started params from reference checkpoint "
-                  f"{args.init_torch_pth}")
+        # identical init on every host by construction: same seed/key
+        params = cannet_init(jax.random.key(args.seed),
+                             batch_norm=args.syncBN)
+        if args.vgg16_npz:
+            params = load_vgg16_frontend(params, args.vgg16_npz)
+            if main_proc and first_gen:
+                print(f"[init] loaded pretrained VGG-16 frontend from "
+                      f"{args.vgg16_npz}")
+        if args.init_torch_pth:
+            # the reference's .pth warm-start — params from the torch
+            # checkpoint, optimizer/step fresh; deterministic file read
+            # on every host => identical init holds
+            from can_tpu.utils.torch_import import load_torch_checkpoint
 
-    # the epoch-0 count is exact for EVERY epoch: an item's bucket cell is a
-    # pure function of its shape, so per-cell counts — hence full batches,
-    # straggler merging, and the remnant plan — cannot vary with the
-    # shuffle (pinned by tests/test_data.py
-    # test_schedule_is_epoch_invariant_in_length_and_shapes and
-    # test_lr_schedule_covers_actual_steps), so the cosine schedule's
-    # endpoint lands exactly on the last step
-    steps_per_epoch = train_batcher.batches_per_epoch(0)
-    schedule = make_lr_schedule(args.lr, world_size=dp,
-                                total_steps=args.epochs * steps_per_epoch,
-                                lrf=args.lrf)
-    optimizer = make_optimizer(schedule)
-    state = create_train_state(params, optimizer, init_batch_stats(params))
+            params = load_torch_checkpoint(args.init_torch_pth)
+            if main_proc and first_gen:
+                print(f"[init] warm-started params from reference "
+                      f"checkpoint {args.init_torch_pth}")
 
-    ckpt = CheckpointManager(args.checkpoint_dir)
-    if main_proc:
-        # persist the schedule-bearing config beside the checkpoints so
-        # the NEXT resume can detect flag drift (checked above)
-        save_run_config(args.checkpoint_dir, run_cfg)
-    start_epoch = 0
-    resumed_best = None
-    if args.init_checkpoint:
-        resume = CheckpointManager(args.init_checkpoint)
-        try:
-            latest = resume.latest_epoch()
-            if latest is not None:
-                state = resume.restore(state)
-                start_epoch = latest + 1
-                # carry the prior leg's best forward so [best]/[done]
-                # report the RUN's best, not the resumed leg's
-                # (code-review r5)
-                resumed_best = resume.best_metric()
-                if main_proc:
-                    print(f"[resume] epoch {latest} from "
-                          f"{args.init_checkpoint}"
-                          + (f" (best so far {resumed_best:.3f})"
-                             if resumed_best is not None else ""))
-            elif main_proc:
-                print(f"[resume] no checkpoint in {args.init_checkpoint}; "
-                      "cold start")
-        finally:
-            # the restore manager must not stay alive for the whole run —
-            # its stale step/metrics view aliases ckpt's directory on an
-            # in-place resume (code-review r5)
-            resume.close()
+        # the epoch-0 count is exact for EVERY epoch (the plan is a pure
+        # function of the shape histogram), so the cosine schedule's
+        # endpoint lands exactly on the last step.  After an elastic
+        # shrink this recomputes at dp': world_size=dp' IS the linear
+        # lr-rescaling rule, and total_steps re-prices the remaining run
+        # at the new schedule granularity — both recorded in the
+        # elastic.transition event.
+        steps_per_epoch = train_batcher.batches_per_epoch(0)
+        schedule = make_lr_schedule(args.lr, world_size=dp,
+                                    total_steps=args.epochs * steps_per_epoch,
+                                    lrf=args.lrf)
+        optimizer = make_optimizer(schedule)
+        state = create_train_state(params, optimizer,
+                                   init_batch_stats(params))
 
-    apply_fn = cannet_apply
-    if args.s2d_stem:
-        if args.sp > 1:
-            raise SystemExit("--s2d-stem is dp-path only (the sp step "
-                             "builds its own sharded apply)")
-        import functools
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        # NOTE: the run config (incl. this generation's world_size) is
+        # persisted AFTER resume resolution — on an in-place resume
+        # (--init_checkpoint == --checkpoint-dir) writing it first would
+        # overwrite the saved world_size the drift check below is about
+        # to read, neutering the guard
 
-        apply_fn = functools.partial(cannet_apply, s2d_stem=True)
-    if bn_ops is not None and args.sp == 1:
-        import functools
-
-        from can_tpu.models.cannet import LocalOps
-
-        # the BN-moments seam rides LocalOps beside context_fused; dp-path
-        # only (the sp step builds its own spatial ops and takes bn_ops
-        # directly below, so a bare LocalOps here would be a trap for any
-        # future sp-branch consumer of apply_fn)
-        apply_fn = functools.partial(apply_fn, ops=LocalOps(bn_ops=bn_ops))
-    remat_policy = make_remat_policy(args.remat,
-                                     global_batch=args.batch_size * dp,
-                                     bf16=args.bf16, announce=main_proc,
-                                     hbm_bytes=hbm, shards=ndev)
-    if args.sp > 1:
-        cache = SpatialStepCache(
-            lambda hw: make_sp_train_step(optimizer, mesh, hw,
-                                          compute_dtype=compute_dtype,
-                                          remat=remat_policy(hw),
-                                          health_metrics=instrument,
-                                          bn_ops=bn_ops))
-
-        def train_step(state, batch):
-            return cache(tuple(batch["image"].shape[1:3]))(state, batch)
-
-        # cost-ledger seam: the underlying jitted step for these args, so
-        # cost_analysis() can be read through the dispatch closure
-        train_step.jit_for = lambda state, batch: cache(
-            tuple(batch["image"].shape[1:3]))
-        eval_step = make_cached_sp_eval_step(mesh, compute_dtype=compute_dtype)
-    else:
-        from can_tpu.cli.common import make_bucketed_train_step
-
-        train_step = make_bucketed_train_step(apply_fn, optimizer, mesh,
-                                              compute_dtype=compute_dtype,
-                                              policy=remat_policy,
-                                              health_metrics=instrument)
-        eval_step = make_dp_eval_step(apply_fn, mesh,
-                                      compute_dtype=compute_dtype)
-    # batches are H-sharded when sp > 1 (train and eval both)
-    put = lambda b: make_global_batch(b, mesh, spatial=args.sp > 1)
-
-    logger = MetricLogger(use_wandb=args.wandb, enabled=main_proc,
-                          name=f"bs{args.batch_size}x{dp}",
-                          config=vars(args),
-                          run_id_file=os.path.join(args.checkpoint_dir,
-                                                   "wandb_run_id.txt"))
-    # telemetry: per-host JSONL (+ MetricLogger adapter, so epoch scalars
-    # reach stdout/wandb exactly as before), heartbeat thread, and the
-    # step-range trace trigger.  With --trace-steps the whole-run
-    # profile_trace below is disarmed — the window replaces it.
-    telemetry, heartbeat, exporter = build_telemetry(
-        args, host_id=process_index(), trace_window=trace_window,
-        logger=logger)
-    if telemetry.ledger is not None:
-        # the drift gauge's denominator: the launch cost THIS run's plans
-        # were priced at, so launch_cost_drift reads as "measured fixed
-        # launch cost / what the planner assumed"
-        telemetry.ledger.plan_launch_cost_px = common["launch_cost_px"]
-    # prepared-store status: one data.prepared event per split (the
-    # one-line fallback record the store contract requires), echoed on
-    # stdout for the main process
-    for split, d in (("train", train_ds), ("test", test_ds)):
-        telemetry.emit("data.prepared", split=split, **d.prepared_note)
-    if main_proc:
-        print("[data] prepared store: " + " ".join(
-            f"{split}={'on' if d.prepared_note['active'] else 'legacy(' + str(d.prepared_note['reason']) + ')'}"
-            for split, d in (("train", train_ds), ("test", test_ds))))
-    # the LOOPS are instrumented only when something consumes per-step
-    # data (JSONL sink, trace window, or live /metrics scraper): the
-    # default run's hot path must stay byte-identical — the bus still
-    # carries the once-per-epoch metrics row to the MetricLogger either way
-    loop_tel = telemetry if instrument else None
-    # the run-health detectors ride the instrumented loop's windowed
-    # fetch: live health.alert events on the same bus, zero extra syncs
-    from can_tpu.obs import HealthMonitor
-
-    health = HealthMonitor(telemetry) if loop_tel is not None else None
-    best_mae = float("inf") if resumed_best is None else float(resumed_best)
-    try:
-        with profile_trace(None if trace_window
-                           else (args.profile_dir or None)):
-            for epoch in range(start_epoch, args.epochs):
-                batches = train_batcher.epoch(epoch)
-                if args.max_steps_per_epoch:
-                    import itertools
-
-                    batches = itertools.islice(batches, args.max_steps_per_epoch)
-                state, stats = train_one_epoch(
-                    train_step, state, batches, put_fn=put, epoch=epoch,
-                    show_progress=main_proc,
-                    total=steps_per_epoch, telemetry=loop_tel,
-                    health=health)
-                # every epoch (not only eval epochs): loss, throughput, and
-                # the shape count — a bucketing misconfiguration shows up
-                # here as distinct_shapes churning mid-run
-                epoch_metrics = {
-                    "train_loss": stats.loss,
-                    "lr": float(schedule(int(state.step))),
-                    "img_per_s": round(stats.img_per_s, 2),
-                    "epoch_s": round(stats.seconds, 2),
-                    "distinct_shapes": stats.distinct_shapes,
-                }
-
-                # always evaluate+checkpoint the FINAL epoch too: with an
-                # interval that doesn't divide --epochs, the trailing
-                # epochs were trained but never saved — the run's last
-                # state was silently discarded at exit (code-review r5)
-                eval_epoch = ((epoch + 1) % args.eval_interval == 0
-                              or epoch == args.epochs - 1)
-                if eval_epoch:
-                    metrics = evaluate(eval_step, state.params,
-                                       test_batcher.epoch(0), put_fn=put,
-                                       dataset_size=test_batcher.dataset_size,
-                                       batch_stats=state.batch_stats,
-                                       telemetry=loop_tel)
-                    mae = metrics["mae"]
-                    epoch_metrics.update(mae=mae, mse=metrics["mse"])
-                # through the bus: the MetricLoggerSink forwards these
-                # scalars to stdout/wandb exactly as logger.log did, and
-                # the JSONL additionally records them as an epoch event.
-                # img_per_s is the GLOBAL (pod-aggregate) rate — num_valid
-                # is GSPMD-reduced in-program, so every host computes the
-                # same number and host 0's MetricLogger reports it.
-                telemetry.emit("epoch", step=epoch, **epoch_metrics)
-                # planner decisions + schedule economics as live gauges
-                # (can_tpu_planner_* on /metrics): the plan is
-                # epoch-invariant so the values are steady — the gauge's
-                # job is to expose them to a scraper DURING the run, and
-                # realized_programs cross-checks the planner's predicted
-                # program count against what the step actually compiled
-                telemetry.emit("data.planner", step=epoch,
-                               realized_programs=stats.programs,
-                               **train_batcher.planner_stats(epoch))
-                if item_cache is not None:
-                    # cumulative counters; the report reads the last event
-                    telemetry.emit("data.cache", step=epoch,
-                                   **item_cache.stats())
-                if eval_epoch:
-                    ckpt.save(epoch, state, mae=mae,
-                              extra={"mse": metrics["mse"]})
-                    if mae < best_mae:
-                        best_mae = mae
+        # -- resume resolution -------------------------------------------
+        # priority: an in-process shrink manifest (the generation that
+        # just dissolved), else — first generation only — a live elastic
+        # manifest in --init_checkpoint (cold restart after preemption),
+        # else the normal latest-epoch resume.
+        manifest = None
+        resumed_from = None
+        manifest_dir = None
+        start_epoch = 0
+        resumed_best = ctx["best_mae"]
+        include = None
+        if ctx["pending_manifest"] is not None:
+            manifest = ctx["pending_manifest"]
+            ctx["pending_manifest"] = None
+            resumed_from = "in_process"
+            manifest_dir = args.checkpoint_dir
+        elif first_gen and args.init_checkpoint:
+            probe = CheckpointManager(args.init_checkpoint)
+            try:
+                latest = probe.latest_epoch()
+                m = el.load_manifest(args.init_checkpoint)
+                if el.manifest_is_live(m, latest):
+                    manifest = m
+                    resumed_from = "cold_restart"
+                    manifest_dir = args.init_checkpoint
+                    resumed_best = probe.best_metric()
+                    # the drift guard with the ELASTIC allowance: the
+                    # live manifest is the permit for a dp-only world
+                    # change — anything else would have failed the
+                    # schedule-key check pre-init
+                    saved_cfg = load_run_config(args.init_checkpoint)
+                    if (saved_cfg is not None
+                            and "world_size" in saved_cfg):
+                        drifted = check_resume_config(
+                            {"world_size": saved_cfg["world_size"]},
+                            {"world_size": dp},
+                            allow=args.allow_config_change,
+                            allow_elastic=True)
+                        if drifted and main_proc:
+                            print(f"[elastic] world drift permitted by "
+                                  f"the live transition manifest: "
+                                  f"world_size "
+                                  f"{saved_cfg['world_size']} -> {dp}")
+                else:
+                    # the drift guard's world check: a saved world_size
+                    # that differs from this world is only legal when an
+                    # elastic transition explains it
+                    saved_cfg = load_run_config(args.init_checkpoint)
+                    if (saved_cfg is not None
+                            and has_checkpoint(args.init_checkpoint)
+                            and "world_size" in saved_cfg):
+                        try:
+                            check_resume_config(
+                                {"world_size": saved_cfg["world_size"]},
+                                {"world_size": dp},
+                                allow=args.allow_config_change,
+                                allow_elastic=False)
+                        except ConfigDriftError as e:
+                            raise SystemExit(
+                                f"{e} — the checkpoint trained at a "
+                                f"different world size and no live "
+                                f"elastic manifest explains the change "
+                                f"(pass --allow-config-change to resume "
+                                f"on the new world anyway)")
+                    if latest is not None:
+                        state = probe.restore(state)
+                        start_epoch = latest + 1
+                        # carry the prior leg's best forward so
+                        # [best]/[done] report the RUN's best
+                        resumed_best = probe.best_metric()
                         if main_proc:
-                            print(f"[best] epoch {epoch}: MAE {mae:.3f}")
-                    if args.show and main_proc:
-                        _save_sample_viz(args, state, test_ds, epoch, logger)
-    except NonFiniteLossError as e:
-        print(f"[abort] {e}", file=sys.stderr)
-        return 1
-    finally:
-        train_batcher.close()
-        test_batcher.close()
-        ckpt.wait()
-        ckpt.close()
-        # one deterministic teardown order for clean exit AND the
-        # SIGTERM path (obs/lifecycle.py): heartbeat -> watchers+sinks
-        # (final SLO eval lands in the artifact, signal handlers
-        # restored, trace window stopped) -> exporter
-        from can_tpu.obs import shutdown_telemetry
+                            print(f"[resume] epoch {latest} from "
+                                  f"{args.init_checkpoint}"
+                                  + (f" (best so far {resumed_best:.3f})"
+                                     if resumed_best is not None else ""))
+                    elif main_proc:
+                        print(f"[resume] no checkpoint in "
+                              f"{args.init_checkpoint}; cold start")
+            finally:
+                # the restore manager must not stay alive for the whole
+                # run — its stale step/metrics view aliases ckpt's
+                # directory on an in-place resume (code-review r5)
+                probe.close()
+        if manifest is not None:
+            # elastic resume: restore the EXACT mid-epoch state from the
+            # shrink checkpoint, replan the interrupted epoch's remaining
+            # items at this world's quantum (exact coverage: consumed ∪
+            # remaining = the epoch, pinned by tests), rescale via the
+            # dp'-built schedule above
+            emgr = CheckpointManager(
+                os.path.join(manifest_dir, el.ELASTIC_SUBDIR))
+            try:
+                state = emgr.restore(state,
+                                     epoch=int(manifest["transition_id"]))
+            finally:
+                emgr.close()
+            start_epoch = int(manifest["epoch"])
+            rem = el.remaining_items(manifest, len(train_ds))
+            include = set(rem) if rem else None
+            if not rem:
+                start_epoch += 1  # interrupted exactly at the epoch end
+            if supervisor is not None:
+                # inherit the transition's host bookkeeping (rank
+                # re-numbering + handled leavers) so a stale signal file
+                # cannot re-trigger the shrink this manifest records
+                supervisor.adopt_manifest(manifest)
+            if main_proc:
+                w_old = manifest["world_old"]
+                print(f"[elastic] resuming generation "
+                      f"{manifest['generation']} transition: epoch "
+                      f"{manifest['epoch']} step {manifest['steps_done']}"
+                      f", world {w_old['processes']}proc/dp{w_old['dp']}"
+                      f" -> {process_count()}proc/dp{dp}, "
+                      f"{len(rem)} item(s) remaining ({resumed_from})")
+        if main_proc:
+            # persist the schedule-bearing config + this generation's
+            # world beside the checkpoints (AFTER the resume resolution
+            # read the previous one): the NEXT resume checks flag drift,
+            # and a dp-only world change is legal exactly when an
+            # elastic manifest explains it
+            save_run_config(args.checkpoint_dir,
+                            dict(run_cfg, world_size=dp))
 
-        shutdown_telemetry(telemetry, heartbeat=heartbeat,
-                           exporter=exporter)
-        logger.finish()
+        apply_fn = cannet_apply
+        if args.s2d_stem:
+            if args.sp > 1:
+                raise SystemExit("--s2d-stem is dp-path only (the sp "
+                                 "step builds its own sharded apply)")
+            import functools
+
+            apply_fn = functools.partial(cannet_apply, s2d_stem=True)
+        if bn_ops is not None and args.sp == 1:
+            import functools
+
+            from can_tpu.models.cannet import LocalOps
+
+            # the BN-moments seam rides LocalOps beside context_fused;
+            # dp-path only (the sp step takes bn_ops directly)
+            apply_fn = functools.partial(apply_fn,
+                                         ops=LocalOps(bn_ops=bn_ops))
+        remat_policy = make_remat_policy(args.remat,
+                                         global_batch=args.batch_size * dp,
+                                         bf16=args.bf16,
+                                         announce=main_proc and first_gen,
+                                         hbm_bytes=hbm, shards=ndev)
+        if args.sp > 1:
+            cache = SpatialStepCache(
+                lambda hw: make_sp_train_step(optimizer, mesh, hw,
+                                              compute_dtype=compute_dtype,
+                                              remat=remat_policy(hw),
+                                              health_metrics=instrument,
+                                              bn_ops=bn_ops))
+
+            def train_step(state, batch):
+                return cache(tuple(batch["image"].shape[1:3]))(state, batch)
+
+            # cost-ledger seam: the underlying jitted step for these
+            # args, so cost_analysis() reads through the closure
+            train_step.jit_for = lambda state, batch: cache(
+                tuple(batch["image"].shape[1:3]))
+            eval_step = make_cached_sp_eval_step(
+                mesh, compute_dtype=compute_dtype)
+        else:
+            from can_tpu.cli.common import make_bucketed_train_step
+
+            train_step = make_bucketed_train_step(
+                apply_fn, optimizer, mesh, compute_dtype=compute_dtype,
+                policy=remat_policy, health_metrics=instrument)
+            eval_step = make_dp_eval_step(apply_fn, mesh,
+                                          compute_dtype=compute_dtype)
+        # batches are H-sharded when sp > 1 (train and eval both)
+        put = lambda b: make_global_batch(b, mesh, spatial=args.sp > 1)
+
+        if first_gen:
+            ctx["logger"] = MetricLogger(
+                use_wandb=args.wandb, enabled=main_proc,
+                name=f"bs{args.batch_size}x{dp}", config=vars(args),
+                run_id_file=os.path.join(args.checkpoint_dir,
+                                         "wandb_run_id.txt"))
+            # telemetry: per-host JSONL (+ MetricLogger adapter),
+            # heartbeat thread, and the step-range trace trigger — built
+            # ONCE; elastic transitions keep emitting into the same bus
+            ctx["telemetry"], ctx["heartbeat"], ctx["exporter"] = \
+                build_telemetry(args, host_id=process_index(),
+                                trace_window=trace_window,
+                                logger=ctx["logger"])
+            if supervisor is not None:
+                supervisor.telemetry = ctx["telemetry"]
+            # prepared-store status: one data.prepared event per split
+            for split, d in (("train", train_ds), ("test", test_ds)):
+                ctx["telemetry"].emit("data.prepared", split=split,
+                                      **d.prepared_note)
+            if main_proc:
+                print("[data] prepared store: " + " ".join(
+                    f"{split}={'on' if d.prepared_note['active'] else 'legacy(' + str(d.prepared_note['reason']) + ')'}"
+                    for split, d in (("train", train_ds),
+                                     ("test", test_ds))))
+        if not first_gen:
+            # a transition may have promoted a DIFFERENT host to main
+            # (the old rank 0 left): the once-constructed logger follows
+            # the role, or stdout/wandb epoch rows silently stop for the
+            # rest of the run.  (A wandb stream stays owned by the
+            # original main if it left — re-initialising a wandb run
+            # mid-process isn't supported; stdout rows resume.)
+            ctx["logger"].enabled = main_proc
+        telemetry = ctx["telemetry"]
+        if telemetry.ledger is not None:
+            # the drift gauge's denominator: the launch cost THIS run's
+            # plans were priced at
+            telemetry.ledger.plan_launch_cost_px = common["launch_cost_px"]
+        if manifest is not None:
+            # the transition record: world change + rescaling, exactly
+            # once per transition (survivor leg or cold restart).
+            # Through the supervisor when armed — its transitions
+            # counter then covers cold restarts too
+            topo_now = {"generation": runtime_generation(),
+                        "process_count": process_count()}
+            emitter = (supervisor.emit_transition
+                       if supervisor is not None else None)
+            if emitter is None:
+                def emitter(m, t, **kw):
+                    el.emit_transition(telemetry, m, t, **kw)
+            emitter(manifest, topo_now, new_dp=dp,
+                    remaining=0 if include is None else len(include),
+                    global_batch_new=host_batch * process_count(),
+                    resumed_from=resumed_from)
+        # the LOOPS are instrumented only when something consumes
+        # per-step data: the default run's hot path stays byte-identical
+        loop_tel = telemetry if instrument else None
+        from can_tpu.obs import HealthMonitor
+
+        health = HealthMonitor(telemetry) if loop_tel is not None else None
+        best_mae = (float("inf") if resumed_best is None
+                    else float(resumed_best))
+        world_closed = False  # elastic branch closes early, pre-reform
+        try:
+            with profile_trace(None if trace_window
+                               else (args.profile_dir or None)):
+                for epoch in range(start_epoch, args.epochs):
+                    inc = include if epoch == start_epoch else None
+                    total = (steps_per_epoch if inc is None else
+                             len(train_batcher.global_schedule(epoch, inc)))
+                    batches = train_batcher.epoch(epoch, inc)
+                    if args.max_steps_per_epoch:
+                        import itertools
+
+                        batches = itertools.islice(
+                            batches, args.max_steps_per_epoch)
+                    on_step = (supervisor.step_hook(epoch)
+                               if supervisor is not None else None)
+                    try:
+                        state, stats = train_one_epoch(
+                            train_step, state, batches, put_fn=put,
+                            epoch=epoch, show_progress=main_proc,
+                            total=total, telemetry=loop_tel,
+                            health=health, on_step=on_step)
+                    except el.ElasticInterrupt as interrupt:
+                        # the agreed shrink point: flush any in-flight
+                        # async save FIRST (its arrays must reach disk
+                        # while the old world's backends are alive),
+                        # checkpoint at a bounded barrier, then leave or
+                        # re-form
+                        ckpt.wait()
+                        sched = train_batcher.global_schedule(epoch, inc)
+                        # prior coverage exists only while TRAINING the
+                        # resumed remainder itself (inc is not None): a
+                        # manifest whose remainder was empty bumped
+                        # start_epoch, and its consumed set belongs to
+                        # the FINISHED epoch, not this one
+                        prior = (manifest.get("consumed", ())
+                                 if manifest is not None
+                                 and inc is not None else ())
+                        new_manifest = supervisor.shrink(
+                            interrupt, state=interrupt.state, epoch=epoch,
+                            checkpoint_dir=args.checkpoint_dir,
+                            schedule=sched, dp=dp, sp=args.sp,
+                            batch_size=host_batch, prior_consumed=prior)
+                        ctx["best_mae"] = (None if best_mae == float("inf")
+                                           else best_mae)
+                        # device-bound teardown BEFORE leave/reform:
+                        # reform() resets the PJRT backends, and the
+                        # generation's finally must not wait on Orbax
+                        # ops whose arrays' backend no longer exists
+                        train_batcher.close()
+                        test_batcher.close()
+                        ckpt.close()
+                        world_closed = True
+                        if process_index() in new_manifest["leavers"]:
+                            if main_proc:
+                                print("[elastic] leaving after shrink "
+                                      "checkpoint (preempted)")
+                            return ("leave", supervisor.leave())
+                        supervisor.reform(new_manifest)
+                        ctx["pending_manifest"] = new_manifest
+                        return ("reform", None)
+                    # every epoch: loss, throughput, shape count
+                    epoch_metrics = {
+                        "train_loss": stats.loss,
+                        "lr": float(schedule(int(state.step))),
+                        "img_per_s": round(stats.img_per_s, 2),
+                        "epoch_s": round(stats.seconds, 2),
+                        "distinct_shapes": stats.distinct_shapes,
+                    }
+
+                    # always evaluate+checkpoint the FINAL epoch too
+                    eval_epoch = ((epoch + 1) % args.eval_interval == 0
+                                  or epoch == args.epochs - 1)
+                    if eval_epoch:
+                        metrics = evaluate(
+                            eval_step, state.params, test_batcher.epoch(0),
+                            put_fn=put,
+                            dataset_size=test_batcher.dataset_size,
+                            batch_stats=state.batch_stats,
+                            telemetry=loop_tel)
+                        mae = metrics["mae"]
+                        epoch_metrics.update(mae=mae, mse=metrics["mse"])
+                    # through the bus: MetricLoggerSink forwards scalars
+                    # to stdout/wandb; JSONL records the epoch event
+                    telemetry.emit("epoch", step=epoch, **epoch_metrics)
+                    telemetry.emit("data.planner", step=epoch,
+                                   realized_programs=stats.programs,
+                                   **train_batcher.planner_stats(epoch))
+                    if item_cache is not None:
+                        telemetry.emit("data.cache", step=epoch,
+                                       **item_cache.stats())
+                    if eval_epoch:
+                        ckpt.save(epoch, state, mae=mae,
+                                  extra={"mse": metrics["mse"]})
+                        if mae < best_mae:
+                            best_mae = mae
+                            ctx["best_mae"] = best_mae
+                            if main_proc:
+                                print(f"[best] epoch {epoch}: "
+                                      f"MAE {mae:.3f}")
+                        if args.show and main_proc:
+                            _save_sample_viz(args, state, test_ds, epoch,
+                                             ctx["logger"])
+        except NonFiniteLossError as e:
+            print(f"[abort] {e}", file=sys.stderr)
+            return ("abort", 1)
+        except CheckpointIOError as e:
+            # the typed give-up after exhausted retries: one incident
+            # bundle (when armed), then a clean abort — the run cannot
+            # promise resumability without its checkpoint
+            inc_mgr = getattr(telemetry, "incidents", None)
+            if inc_mgr is not None:
+                inc_mgr.on_exception(e, phase="checkpoint")
+            print(f"[abort] {e}", file=sys.stderr)
+            return ("abort", 1)
+        finally:
+            if not world_closed:
+                train_batcher.close()
+                test_batcher.close()
+                ckpt.wait()
+                ckpt.close()
+        ctx["best_mae"] = None if best_mae == float("inf") else best_mae
+        if main_proc:
+            print(f"[done] best MAE {best_mae:.3f}")
+        return ("done", 0)
+
+    from can_tpu.parallel.runtime import generation as runtime_generation
+
+    try:
+        while True:
+            outcome, rc = run_generation()
+            if outcome != "reform":
+                return rc
+            # else: a new generation formed — loop rebuilds the world
+    finally:
+        # one deterministic teardown order for clean exit, abort, leave,
+        # AND the SIGTERM path (obs/lifecycle.py): heartbeat ->
+        # watchers+sinks -> exporter; then the supervisor's signal hook
+        # and the runtime (idempotent after a leave)
+        if ctx["telemetry"] is not None:
+            from can_tpu.obs import shutdown_telemetry
+
+            shutdown_telemetry(ctx["telemetry"], heartbeat=ctx["heartbeat"],
+                               exporter=ctx["exporter"])
+        if ctx["logger"] is not None:
+            ctx["logger"].finish()
+        if supervisor is not None:
+            supervisor.close()
         shutdown_runtime()  # the reference never calls its cleanup()
-    if main_proc:
-        print(f"[done] best MAE {best_mae:.3f}")
-    return 0
+
 
 
 _viz_forward = None  # module-level so repeat shapes hit the jit cache

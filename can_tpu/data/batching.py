@@ -236,6 +236,11 @@ class ShardedBatcher:
                               else float(max_launch_px))
         self._cap_warned: set = set()
         self._plan_cache = None
+        # last subset schedule, keyed (epoch, frozenset(include)): the
+        # elastic resume asks for the identical subset schedule 2-3
+        # times (progress total, epoch(), a possible second shrink) and
+        # each build pays an uncached planner run over the subset
+        self._subset_cache: Optional[Tuple[Tuple[int, frozenset], list]] = None
         # host loader threads (the reference's DataLoader num_workers,
         # train.py:90, done with threads: PIL decode / cv2 resize release
         # the GIL, and threads share the process — no pickling, no fork
@@ -562,6 +567,26 @@ class ShardedBatcher:
                 for i in range(len(self.dataset))))
         return counts
 
+    def _plan_for_counts(self, counts: Dict[Tuple[int, int], int]):
+        """One ``planner.Plan`` for an arbitrary cell-count histogram —
+        the full epoch's (cached by ``_partial_plan``) or an elastic
+        REMAINDER's (the uncovered items of an interrupted epoch,
+        replanned at the new world's quantum; ``global_schedule``'s
+        ``include`` path).  A pure function of (counts, cost model,
+        budget), so every host derives the identical plan."""
+        from can_tpu.data.planner import GlobalPlanner
+
+        def warn(msg):
+            tag = msg[:40]
+            if tag not in self._cap_warned:
+                self._cap_warned.add(tag)
+                print(f"[batching] WARNING: {msg}")
+
+        planner = GlobalPlanner(self._cost_model(),
+                                max_buckets=self.max_buckets,
+                                mode=self.plan_mode, warn=warn)
+        return planner.plan_with_fallback(counts)
+
     def _partial_plan(self):
         """Epoch-invariant launch plan for ladder+remnant mode.
 
@@ -578,18 +603,7 @@ class ShardedBatcher:
         """
         if self._plan_cache is not None:
             return self._plan_cache
-        from can_tpu.data.planner import GlobalPlanner
-
-        def warn(msg):
-            tag = msg[:40]
-            if tag not in self._cap_warned:
-                self._cap_warned.add(tag)
-                print(f"[batching] WARNING: {msg}")
-
-        planner = GlobalPlanner(self._cost_model(),
-                                max_buckets=self.max_buckets,
-                                mode=self.plan_mode, warn=warn)
-        self._plan_cache = planner.plan_with_fallback(self._cell_counts())
+        self._plan_cache = self._plan_for_counts(self._cell_counts())
         return self._plan_cache
 
     def program_count(self, epoch: int = 0) -> int:
@@ -638,15 +652,40 @@ class ShardedBatcher:
             )
         return stats
 
-    def global_schedule(self, epoch: int) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
+    def global_schedule(self, epoch: int, include: Optional[set] = None
+                        ) -> List[Tuple[Tuple[int, int], List[Tuple[int, bool]]]]:
         """Deterministic global batch plan: [(bucket_hw, [(idx, valid)] of
         length global_batch)] — identical on every host for a given
-        (seed, epoch)."""
+        (seed, epoch).
+
+        ``include`` restricts the plan to a subset of item indices — the
+        elastic-resume path: the uncovered REMAINDER of an interrupted
+        epoch is replanned (fresh ``_plan_for_counts`` over the subset
+        histogram, at THIS batcher's quantum — i.e. the shrunk world's)
+        while keeping the epoch's shuffle order, so consumed ∪ scheduled
+        covers the epoch exactly once across the transition.  Every host
+        passes the same set (derived from the shared elastic manifest)
+        and computes the identical plan; the last subset schedule is
+        memoised (the resume leg asks for it 2-3 times)."""
+        if include is None:
+            return self._build_schedule(epoch, None)
+        key = (epoch, frozenset(int(i) for i in include))
+        if self._subset_cache is not None and self._subset_cache[0] == key:
+            return self._subset_cache[1]
+        sched = self._build_schedule(epoch, set(key[1]))
+        self._subset_cache = (key, sched)
+        return sched
+
+    def _build_schedule(self, epoch: int, include: Optional[set]):
         n = len(self.dataset)
         if self.shuffle:
             order = np.random.default_rng((self.seed, epoch)).permutation(n)
         else:
             order = np.arange(n)
+        if include is not None:
+            include = set(int(i) for i in include)
+            order = np.asarray([i for i in order.tolist() if i in include],
+                               dtype=np.int64)
         gbs = self.batch_size * self.process_count
         remnant_mode = self.remnant_sizes
         menu = self._remnant_menu() if remnant_mode else None
@@ -658,9 +697,15 @@ class ShardedBatcher:
             # host and in every epoch; the shuffle only decides which
             # concrete items fill the slots) fixes each cell's full-launch
             # sizes AND the straggler groups' join cells + part sizes.
-            # legacy_fallback means the planner proved the
-            # pad-every-straggler-to-gbs path cheaper — fall through.
-            plan = self._partial_plan()
+            # An ``include`` subset gets its own (uncached) plan over the
+            # subset histogram.  legacy_fallback means the planner proved
+            # the pad-every-straggler-to-gbs path cheaper — fall through.
+            if include is None:
+                plan = self._partial_plan()
+            else:
+                plan = self._plan_for_counts(dict(collections.Counter(
+                    self._bucket_key(self._item_shape(int(i)))
+                    for i in order.tolist())))
             if plan.legacy_fallback:
                 plan = None
         if plan is not None:
@@ -756,7 +801,7 @@ class ShardedBatcher:
     def batches_per_epoch(self, epoch: int = 0) -> int:
         return len(self.global_schedule(epoch))
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
+    def epoch(self, epoch: int, include: Optional[set] = None) -> Iterator[Batch]:
         """Yield this host's slice of each global batch, in schedule order.
 
         With ``num_workers > 0``, item loads (decode + resize + flip) run on
@@ -765,6 +810,12 @@ class ShardedBatcher:
         reference's default) parallelism.  Output order and content are
         identical to the serial path: each item's RNG is keyed on
         (seed, epoch, idx), so determinism is independent of thread timing.
+
+        ``include`` yields only the subset schedule (see
+        ``global_schedule``) — the elastic remainder of an interrupted
+        epoch.  Item RNG keys are unchanged, so a subset item's
+        flip/augmentation is bit-identical to the one the uninterrupted
+        epoch would have applied.
         """
         def host_slice(group):
             # groups are gbs long, except remnant sub-batches (menu sizes,
@@ -773,7 +824,7 @@ class ShardedBatcher:
             lo = self.process_index * sub
             return group[lo:lo + sub]
 
-        schedule = self.global_schedule(epoch)
+        schedule = self.global_schedule(epoch, include)
         pool = self._ensure_pool()
         if pool is None:
             for key, group in schedule:

@@ -452,6 +452,23 @@ class GlobalPlanner:
                     legacy_fallback=True)
 
 
+def schedule_coverage(schedule) -> Dict[int, int]:
+    """Valid-slot occurrences per item index over a realized schedule —
+    the exact-coverage invariant's measurable form.  A correct epoch (or
+    an elastic remainder replanned at a new quantum after a shrink)
+    covers each of its items EXACTLY once: ``schedule_coverage(sched) ==
+    {i: 1 for i in items}``.  Fill slots (valid=False) are excluded — a
+    duplicated index with a zero sample mask contributes nothing.  Used
+    by the elastic tests and the supervisor's resume-time sanity check;
+    pure and jax-free."""
+    seen: Dict[int, int] = {}
+    for _key, group in schedule:
+        for idx, valid in group:
+            if valid:
+                seen[int(idx)] = seen.get(int(idx), 0) + 1
+    return seen
+
+
 def remnant_menu(gbs: int, quantum: int, *, mode: str = "cost") -> Tuple[int, ...]:
     """Legal launch sizes (global units), descending.
 

@@ -68,6 +68,10 @@ import numpy as np
 # can_tpu_incidents_total{reason}), and slo.burn is one objective's
 # multi-window burn-rate evaluation (obs/slo.py — exported as
 # can_tpu_slo_* gauges; `alerting` payloads trigger incident bundles).
+# elastic.transition comes from the elastic supervisor
+# (parallel/elastic.py): one completed shrink-and-continue transition —
+# old/new world (processes, dp), interrupted epoch + step, consumed vs
+# remaining items, and the lr/global-batch rescaling applied.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
@@ -76,7 +80,8 @@ EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",
                "perf.summary", "trace.span",
-               "incident.bundle", "slo.burn")
+               "incident.bundle", "slo.burn",
+               "elastic.transition")
 
 
 def _jsonable(v):

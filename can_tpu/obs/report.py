@@ -87,6 +87,8 @@ def summarize(events: Iterable[dict]) -> dict:
     incident_last: Optional[dict] = None
     slo_last: dict = {}
     slo_alert_events = 0
+    elastic_transitions = 0
+    elastic_last: Optional[dict] = None
     for e in events:
         kind = e.get("kind", "?")
         by_kind[kind] = by_kind.get(kind, 0) + 1
@@ -175,6 +177,9 @@ def summarize(events: Iterable[dict]) -> dict:
             slo_last[str(p.get("objective", "?"))] = p  # last eval wins
             if p.get("alerting"):
                 slo_alert_events += 1
+        elif kind == "elastic.transition":
+            elastic_transitions += 1
+            elastic_last = p  # the newest world formation wins
         elif kind == "perf.summary":
             perf_last = p  # the ledger is cumulative: the last wins
         elif kind == "trace.span":
@@ -273,6 +278,20 @@ def summarize(events: Iterable[dict]) -> dict:
         "incidents_by_reason": dict(sorted(incidents_by_reason.items())),
         "incident_last_path": (incident_last.get("path")
                                if incident_last else None),
+        # elastic transitions (parallel/elastic.py); zeros/Nones when the
+        # run never shrank
+        "elastic_transitions": elastic_transitions,
+        "elastic_last": (None if elastic_last is None else {
+            "epoch": elastic_last.get("epoch"),
+            "steps_done": elastic_last.get("steps_done"),
+            "processes_old": elastic_last.get("processes_old"),
+            "processes_new": elastic_last.get("processes_new"),
+            "dp_old": elastic_last.get("dp_old"),
+            "dp_new": elastic_last.get("dp_new"),
+            "lr_scale": elastic_last.get("lr_scale"),
+            "remaining_items": elastic_last.get("remaining_items"),
+            "reason": elastic_last.get("reason"),
+        }),
         "slo_objectives": {
             name: {"burn_min": p.get("burn_min"),
                    "burn_max": p.get("burn_max"),
@@ -366,6 +385,20 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
         rows.append(("trace spans",
                      f"{summary['trace_spans']} ("
                      + " ".join(f"{k}={n}" for k, n in names.items()) + ")"))
+    if summary.get("elastic_transitions"):
+        e = summary.get("elastic_last") or {}
+        rows.append(
+            ("elastic",
+             f"transitions={summary['elastic_transitions']} "
+             f"last: epoch {_fmt(e.get('epoch'))} "
+             f"step {_fmt(e.get('steps_done'))} "
+             f"world {_fmt(e.get('processes_old'))}proc/"
+             f"dp{_fmt(e.get('dp_old'))} -> "
+             f"{_fmt(e.get('processes_new'))}proc/"
+             f"dp{_fmt(e.get('dp_new'))} "
+             f"lr x{_fmt(e.get('lr_scale'))} "
+             f"remaining={_fmt(e.get('remaining_items'))} "
+             f"({e.get('reason', '?')})"))
     if summary.get("incidents"):
         by_reason = summary.get("incidents_by_reason") or {}
         rows.append(("incidents",

@@ -7,7 +7,11 @@ from .runtime import (
     is_main_process,
     barrier,
     reduce_value,
+    agree_max_value,
     agree_min_value,
+    generation,
+    runtime_active,
+    RendezvousTimeoutError,
 )
 from .data_parallel import (
     make_global_batch,
@@ -26,7 +30,11 @@ __all__ = [
     "is_main_process",
     "barrier",
     "reduce_value",
+    "agree_max_value",
     "agree_min_value",
+    "generation",
+    "runtime_active",
+    "RendezvousTimeoutError",
     "make_global_batch",
     "make_dp_train_step",
     "make_dp_eval_step",

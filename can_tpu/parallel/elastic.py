@@ -1,0 +1,622 @@
+"""Elastic shrink-and-continue: the preemption-tolerant training supervisor.
+
+The reference framework dies whole-job when any rank dies (synchronous
+NCCL DDP, reference train.py:121-122).  On a real TPU fleet preemption is
+the NORMAL failure mode, and every ingredient to survive it already
+exists in this tree — run_monitor detects dead hosts, Orbax resume is
+exact, the drift guard validates configs, the cost planner replans
+deterministically for any dp, and the incident layer dumps a bundle on
+SIGTERM.  This module joins them into one choreography:
+
+1. **Signal** — a preemption notice arrives: SIGTERM on some host (the
+   supervisor's handler chains AFTER the incident manager's bundle dump,
+   sets the leaving flag, and writes a machine-readable ``leave`` file),
+   a ``dead`` signal file from ``tools/run_monitor.py --emit-signal``, or
+   an injected fault (can_tpu/testing/faults.py delivering a real
+   SIGTERM at a seeded step).
+2. **Agreement** — every host's per-step loop hook polls its local
+   sources, and every ``check_every`` steps all hosts allgather their
+   leave/dead bitmasks (``runtime.agree_max_value`` — set-union on 0/1
+   masks).  The allgather is lockstep, so every host derives the SAME
+   leaver set at the SAME step boundary — the property that keeps the
+   world consistent while it dissolves.  The hook then raises
+   :class:`ElasticInterrupt` out of ``train_one_epoch`` (which attaches
+   the live mid-epoch train state to the exception instead of treating
+   it as an incident).
+3. **Shrink checkpoint at a barrier** — inside the preemption grace
+   window, ALL members of the dying generation (leavers included) save
+   the full train state through the multihost Orbax path into
+   ``<checkpoint_dir>/elastic/`` keyed by the runtime generation, the
+   main process writes the elastic manifest (``elastic.json``,
+   manifest-LAST so a torn shrink reads as absent), and everyone meets
+   a BOUNDED barrier — a hang here becomes a typed
+   ``RendezvousTimeoutError`` plus an incident bundle, never a silent
+   wait through the preemptor's SIGKILL.
+4. **Re-formation** — leavers run the coordinated
+   ``shutdown_runtime()`` and exit ``LEAVE_EXIT_CODE``; survivors tear
+   down WITH backend reset and re-init the now generation-counted
+   runtime at the shrunk world (single survivor: plain single-process
+   init; several: re-rendezvous at ranks re-derived by
+   :func:`plan_reformation`, coordinator from the ``stay`` files).
+5. **Resume** — the caller rebuilds mesh/steps/batcher for dp′, restores
+   the shrink checkpoint, rescales lr/global-batch (per-replica batch is
+   invariant; lr follows the linear scaling rule, i.e. a schedule built
+   with ``world_size=dp′``), replans the REMAINING items of the
+   interrupted epoch (``ShardedBatcher.epoch(e, include=remaining)`` —
+   exact once-per-epoch coverage preserved, planner replans for the new
+   quantum), emits one ``elastic.transition`` telemetry event, and
+   continues.  A COLD restart at dp′ reads the very same manifest and
+   runs the very same resume leg — bit-identical by construction, which
+   is exactly what the chaos test pins.
+
+The monitor-facing signal-file format lives in ``can_tpu/obs/signals.py``
+(the jax-free zone — this module sits inside ``can_tpu.parallel``, whose
+package import pulls jax): ``run_monitor --emit-signal`` writes the same
+files this supervisor polls without ever importing jax.  This module
+itself defers jax/runtime imports to call time, so constructing a
+supervisor or parsing a manifest costs no device initialisation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import socket
+import time
+from typing import Callable, Iterable, List, Optional, Sequence, Set
+
+# the monitor ↔ supervisor signal-file interface lives in obs/signals.py
+# (jax-free zone: run_monitor --emit-signal writes the same files this
+# supervisor polls); re-exported here as the supervisor-side API
+from can_tpu.obs.signals import (  # noqa: F401  (re-exports)
+    SIGNAL_SCHEMA,
+    leaver_hosts,
+    read_signals,
+    signal_path,
+    write_signal,
+)
+
+MANIFEST_SCHEMA = "can_tpu.elastic.v1"
+MANIFEST_NAME = "elastic.json"
+ELASTIC_SUBDIR = "elastic"
+#: the leaver's exit code after a clean coordinated leave (128 + SIGTERM,
+#: what a preemptor's supervisor expects from a graceful shutdown)
+LEAVE_EXIT_CODE = 143
+#: base port for multi-survivor re-rendezvous (offset by generation so a
+#: second transition can't collide with a socket lingering from the first)
+REFORM_PORT_BASE = 8576
+
+
+# -- elastic manifest -----------------------------------------------------
+def manifest_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, MANIFEST_NAME)
+
+
+def save_manifest(checkpoint_dir: str, manifest: dict) -> str:
+    path = manifest_path(checkpoint_dir)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(checkpoint_dir: str) -> Optional[dict]:
+    """The checkpoint dir's elastic manifest, or None when absent/torn/
+    wrong-schema (a shrink killed before its final write is NOT a
+    transition — the manifest-last rule, same as incident bundles)."""
+    try:
+        with open(manifest_path(checkpoint_dir)) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != MANIFEST_SCHEMA:
+        return None
+    return doc
+
+
+def manifest_is_live(manifest: Optional[dict],
+                     latest_epoch: Optional[int]) -> bool:
+    """Should a resume honor this manifest?  Only when no COMPLETED-epoch
+    checkpoint at or beyond the interrupted epoch exists — once the
+    resumed leg finishes that epoch and saves normally, the manifest is
+    history, and a later crash must restart from the newer normal
+    checkpoint, not replay a stale mid-epoch plan."""
+    if manifest is None:
+        return False
+    return latest_epoch is None or latest_epoch < int(manifest["epoch"])
+
+
+def consumed_items(schedule: Sequence, steps_done: int) -> List[int]:
+    """Item indices the first ``steps_done`` launches of a global
+    schedule covered (valid slots only — fill slots carry a duplicated
+    index with valid=False and consumed nothing)."""
+    out: Set[int] = set()
+    for key, group in schedule[:steps_done]:
+        for idx, valid in group:
+            if valid:
+                out.add(int(idx))
+    return sorted(out)
+
+
+def remaining_items(manifest: dict, dataset_size: int) -> List[int]:
+    """The interrupted epoch's still-uncovered items — the ``include``
+    set the resumed leg's batcher replans over (exact once-per-epoch
+    coverage: consumed ∪ remaining = the epoch, disjoint)."""
+    consumed = set(int(i) for i in manifest.get("consumed", ()))
+    bad = consumed - set(range(dataset_size))
+    if bad:
+        raise ValueError(
+            f"elastic manifest names consumed items {sorted(bad)[:5]} "
+            f"outside the dataset (size {dataset_size}) — wrong dataset "
+            f"for this checkpoint?")
+    return [i for i in range(dataset_size) if i not in consumed]
+
+
+# -- re-formation planning (pure; unit-testable without a cluster) --------
+def plan_reformation(*, n_processes: int, leavers: Iterable[int],
+                     process_index: int) -> dict:
+    """Who stays, and at what new rank.  Survivor ranks are the old ranks
+    minus the leavers, re-numbered in old-rank order — every host derives
+    this identically from the agreed leaver set."""
+    leavers = {int(x) for x in leavers}
+    bad = leavers - set(range(n_processes))
+    if bad:
+        raise ValueError(f"leaver ids {sorted(bad)} outside the "
+                         f"{n_processes}-process world")
+    if not leavers:
+        raise ValueError("no leavers: nothing to re-form")
+    survivors = [r for r in range(n_processes) if r not in leavers]
+    return {
+        "survivors": survivors,
+        "leaving": process_index in leavers,
+        "new_num_processes": len(survivors),
+        "new_process_id": (survivors.index(process_index)
+                           if process_index in survivors else None),
+    }
+
+
+def reform_coordinator(signal_dir: str, survivors: Sequence[int],
+                       *, generation: int) -> Optional[str]:
+    """The shrunk world's coordinator address: the lowest-ranked
+    survivor's ``stay`` file advertises it (written during the shrink,
+    while the old world was still whole).  None for a 1-survivor world
+    (single-process init needs no coordinator)."""
+    if len(survivors) <= 1:
+        return None
+    for s in read_signals(signal_dir):
+        if (s.get("kind") == "stay"
+                and int(s.get("host_id", -1)) == int(survivors[0])):
+            addr = s.get("detail", {}).get("address")
+            if addr:
+                return str(addr)
+    raise RuntimeError(
+        f"no stay-file advertises a coordinator for survivors "
+        f"{list(survivors)} in {signal_dir} (generation {generation}) — "
+        f"the shrink barrier passed without the lowest survivor's "
+        f"advertisement?")
+
+
+def reform_port(generation: int) -> int:
+    return REFORM_PORT_BASE + generation % 1000
+
+
+def _bounded_agree(mask, *, generation: int,
+                   timeout_s: Optional[float] = None):
+    """``runtime.agree_max_value`` with a bounded wait (via
+    ``runtime.bounded_wait``): the allgather needs EVERY current member,
+    and a hard-dead peer (no grace window) would otherwise hang the
+    survivors unboundedly.  On timeout raises the same typed
+    ``RendezvousTimeoutError`` the barriers use — the loop's incident
+    hook bundles it and the process exits into the restart-resume path.
+    Single-process worlds return immediately."""
+    from can_tpu.parallel import runtime
+
+    if runtime.process_count() <= 1:
+        return mask
+    if timeout_s is None:
+        timeout_s = runtime.DEFAULT_BARRIER_TIMEOUT_S
+    if timeout_s <= 0:
+        return runtime.agree_max_value(mask)
+    return runtime.bounded_wait(
+        lambda: runtime.agree_max_value(mask),
+        name="elastic-agreement", timeout_s=timeout_s,
+        generation=generation,
+        detail="a fleet member never joined the leave-agreement "
+               "allgather (hard death without a grace window?) — "
+               "restart the survivors and resume from the last "
+               "checkpoint")
+
+
+# -- control flow ---------------------------------------------------------
+class ElasticInterrupt(Exception):
+    """The agreed shrink point: raised by the supervisor's step hook out
+    of ``train_one_epoch``, which attaches the LIVE mid-epoch train state
+    (``.state``) and its own step count (``.steps_done``) before
+    unwinding — control flow, deliberately NOT an incident (the loops
+    exclude it from the incident hook like ``NonFiniteLossError``)."""
+
+    def __init__(self, *, steps_done: int, leavers: Set[int],
+                 reason: str = "preemption"):
+        self.steps_done = int(steps_done)
+        self.leavers = set(leavers)
+        self.reason = str(reason)
+        self.state = None  # attached by train_one_epoch on the way out
+        super().__init__(
+            f"elastic shrink agreed at step {steps_done}: "
+            f"host(s) {sorted(self.leavers)} leaving ({reason})")
+
+
+class ElasticSupervisor:
+    """Owns one process's side of the shrink-and-continue choreography.
+
+    signal_dir: shared directory for leave/dead/stay files (a shared FS
+      path on a pod; any local dir single-host).  Detection composes:
+      this supervisor polls the same files ``run_monitor --emit-signal``
+      writes.
+    telemetry: optional bus — transition events, and incident bundles on
+      choreography failures (via ``telemetry.incidents`` when armed).
+    check_every: steps between fleet agreement polls (each poll is one
+      tiny host allgather at world > 1; 1 = react within a step).
+    barrier_timeout_s: bound for the shrink/re-formation barriers
+      (default ``runtime.DEFAULT_BARRIER_TIMEOUT_S``).
+    """
+
+    def __init__(self, signal_dir: str, *, telemetry=None,
+                 check_every: int = 4,
+                 barrier_timeout_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        if not signal_dir:
+            raise ValueError("signal_dir is required")
+        os.makedirs(signal_dir, exist_ok=True)
+        self.signal_dir = signal_dir
+        self.telemetry = telemetry
+        self.check_every = max(1, int(check_every))
+        self.barrier_timeout_s = barrier_timeout_s
+        self._clock = clock
+        self._leaving = False
+        self._leave_reason: Optional[str] = None
+        self._restore_signal = None
+        self.transitions = 0
+        # signal files name ORIGINAL host ids (stable across generations
+        # — telemetry host ids); runtime ranks are re-numbered at every
+        # re-formation.  rank_to_host maps current rank -> original id
+        # (None = identity, the first generation); _handled holds ids
+        # whose departure was already shrunk around, so a stale leave
+        # file — or a monitor re-emitting 'dead' for a host that is
+        # GONE, not dying — can never trigger a second, cascading shrink
+        # that names an innocent re-numbered rank.
+        self.rank_to_host: Optional[List[int]] = None
+        self._handled: Set[int] = set()
+
+    def _rank_map(self, n: int) -> List[int]:
+        """Current rank -> original host id (identity until a
+        re-formation re-numbers the survivors)."""
+        return (self.rank_to_host if self.rank_to_host is not None
+                else list(range(n)))
+
+    def adopt_manifest(self, manifest: dict) -> None:
+        """Inherit a transition's bookkeeping: the survivors' original
+        host ids become this generation's rank map, and the leavers'
+        ids are marked handled (their stale signal files are history).
+        Called by :meth:`reform` in-process and by cold restarts that
+        resume from the same manifest."""
+        hosts = manifest.get("survivor_hosts")
+        if hosts:
+            self.rank_to_host = [int(h) for h in hosts]
+        self._handled.update(int(h) for h in
+                             manifest.get("leaver_hosts",
+                                          manifest.get("leavers", ())))
+
+    # -- signal sources ---------------------------------------------------
+    def notice_preemption(self, reason: str = "sigterm") -> None:
+        """This host is being preempted: set the leaving flag (picked up
+        at the next step boundary) and announce it in the signal dir so
+        peers and monitors see it even before the next agreement poll."""
+        self._leaving = True
+        self._leave_reason = reason
+        from can_tpu.parallel import runtime
+
+        try:
+            n = runtime.process_count()
+            write_signal(self.signal_dir, kind="leave",
+                         host_id=self._rank_map(n)[runtime.process_index()],
+                         reason=reason)
+        except OSError as e:
+            # the allgathered flag still drives the agreement; the file
+            # is the monitor-facing record
+            print(f"[elastic] leave-signal write failed: {e}", flush=True)
+
+    def install_signal_hook(self, signum: int = _signal.SIGTERM):
+        """Chain onto SIGTERM: set the leaving flag and RETURN, so the
+        grace window is spent in the shrink choreography instead of
+        dying mid-collective.  Install BEFORE the incident manager's
+        hook (obs.install_sigterm_handler): the manager then runs first
+        (preemption bundle) and chains here instead of SystemExit.
+        Main-thread only; returns a restore() callable or None."""
+        def _handler(sig, frame):
+            self.notice_preemption("sigterm")
+
+        try:
+            previous = _signal.signal(signum, _handler)
+        except ValueError:  # not the main thread
+            return None
+
+        def restore():
+            try:
+                _signal.signal(signum, previous
+                               if previous is not None else _signal.SIG_DFL)
+            # can-tpu-lint: disable=SWALLOW(teardown restore is best-effort; process is exiting)
+            except (ValueError, TypeError):
+                pass
+
+        self._restore_signal = restore
+        return restore
+
+    def close(self) -> None:
+        if self._restore_signal is not None:
+            self._restore_signal()
+            self._restore_signal = None
+
+    # -- the loop hook ----------------------------------------------------
+    def step_hook(self, epoch: int) -> Callable[[int], None]:
+        """The per-step callable ``train_one_epoch(on_step=...)`` runs
+        after each completed step: fault delivery, local signal poll,
+        and — every ``check_every`` steps — the lockstep fleet agreement.
+        Raises :class:`ElasticInterrupt` at the agreed shrink step."""
+        from can_tpu.parallel import runtime
+        from can_tpu.testing.faults import active_injector
+
+        def on_step(step: int) -> None:
+            inj = active_injector()
+            if inj is not None:
+                inj.on_step(step, epoch=epoch,
+                            rank=runtime.process_index())
+            # poll on the cadence AND on every epoch's first step: step
+            # resets per epoch, so an epoch SHORTER than check_every
+            # would otherwise never reach a poll and the whole layer
+            # would be silently inert on small datasets
+            if step != 1 and step % self.check_every:
+                return
+            n = runtime.process_count()
+            rank = runtime.process_index()
+            rank_map = self._rank_map(n)
+            import numpy as np
+
+            mask = np.zeros((n,), np.float32)
+            if self._leaving:
+                mask[rank] = 1.0
+            # signal files name ORIGINAL host ids; only ids that map to
+            # a CURRENT member and were not already shrunk around count
+            # (a stale leave file or a re-emitting monitor must not
+            # cascade a second shrink onto a re-numbered innocent rank)
+            ids = leaver_hosts(read_signals(self.signal_dir)) - self._handled
+            for r in range(n):
+                if rank_map[r] in ids:
+                    mask[r] = 1.0
+            # ONE lockstep allgather: every host contributes its local
+            # view at the same step boundary and derives the same union.
+            # BOUNDED: a peer that died with NO grace window (SIGKILL)
+            # never enters the collective — that must become a typed
+            # error + incident bundle and a restart-resume from the last
+            # checkpoint, never a silent hang through the preemptor's
+            # window (in-process shrink requires the grace model; see
+            # DESIGN §17).
+            agreed = _bounded_agree(mask, generation=runtime.generation(),
+                                    timeout_s=self.barrier_timeout_s)
+            leavers = {i for i in range(n) if agreed[i] > 0}
+            if leavers:
+                raise ElasticInterrupt(
+                    steps_done=step, leavers=leavers,
+                    reason=self._leave_reason or "peer_signal")
+
+        return on_step
+
+    # -- the shrink choreography ------------------------------------------
+    def shrink(self, interrupt: ElasticInterrupt, *, state, epoch: int,
+               checkpoint_dir: str, schedule: Sequence, dp: int,
+               sp: int = 1, batch_size: int = 1,
+               prior_consumed: Sequence = ()) -> dict:
+        """Steps 3 of the choreography: shrink checkpoint + manifest +
+        bounded barrier, run by EVERY member of the dying generation
+        (leavers inside their grace window).  Returns the manifest; the
+        caller then forks on ``plan_reformation(...)['leaving']`` —
+        :meth:`leave` or :meth:`reform`.
+
+        schedule: the interrupted epoch's global schedule (consumed items
+        derive from its first ``steps_done`` launches).
+        dp/sp/batch_size: the dying world's mesh + per-host batch, for
+        the manifest's rescaling record.
+        prior_consumed: items already covered by an EARLIER transition of
+        the same epoch (a second shrink during a resumed leg: coverage
+        accumulates across transitions, or the epoch double-trains)."""
+        from can_tpu.parallel import runtime
+        from can_tpu.utils.checkpoint import CheckpointManager
+
+        gen = runtime.generation()
+        n = runtime.process_count()
+        rank = runtime.process_index()
+        rank_map = self._rank_map(n)
+        plan = plan_reformation(n_processes=n, leavers=interrupt.leavers,
+                                process_index=rank)
+        local_devices = _local_device_count()
+        new_procs = plan["new_num_processes"]
+        # predicted shrunk world (assumes homogeneous hosts — true on a
+        # pod; the resume leg records the ACTUAL world it forms)
+        new_devices = local_devices * max(new_procs, 1)
+        new_dp = max(new_devices // max(sp, 1), 1)
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "ts": self._clock(),
+            "generation": gen,
+            "transition_id": gen,
+            "epoch": int(epoch),
+            "steps_done": int(interrupt.steps_done),
+            "consumed": sorted(
+                set(int(i) for i in prior_consumed)
+                | set(consumed_items(schedule, interrupt.steps_done))),
+            "reason": interrupt.reason,
+            "leavers": sorted(interrupt.leavers),
+            "survivors": plan["survivors"],
+            # ORIGINAL host ids (stable across generations — ranks are
+            # re-numbered at re-formation): the next generation's rank
+            # map and stale-signal filter
+            "leaver_hosts": sorted(rank_map[r] for r in interrupt.leavers),
+            "survivor_hosts": [rank_map[s] for s in plan["survivors"]],
+            "world_old": {"processes": n, "dp": int(dp), "sp": int(sp),
+                          "devices": int(dp) * int(sp),
+                          "batch_size": int(batch_size)},
+            "world_new": {"processes": new_procs, "dp": int(new_dp),
+                          "sp": int(sp), "devices": new_devices},
+            "lr_scale": new_dp / max(int(dp), 1),
+        }
+        if not plan["leaving"] and new_procs > 1:
+            # advertise this survivor's re-rendezvous address while the
+            # old world can still read it (reform_coordinator consumes
+            # the lowest survivor's)
+            write_signal(self.signal_dir, kind="stay", host_id=rank,
+                         reason="reform",
+                         detail={"address": f"{socket.gethostname()}:"
+                                            f"{reform_port(gen)}"})
+        try:
+            mgr = CheckpointManager(
+                os.path.join(checkpoint_dir, ELASTIC_SUBDIR))
+            try:
+                # metrics are a best-checkpoint concern; a shrink save is
+                # a continuation point, not a candidate best — 0.0 keeps
+                # the metrics JSON finite and the manager content
+                mgr.save(gen, state, mae=0.0)
+                mgr.wait()
+            finally:
+                mgr.close()
+            if runtime.is_main_process():
+                save_manifest(checkpoint_dir, manifest)  # manifest LAST
+            runtime.barrier(f"elastic-shrink-g{gen}",
+                            timeout_s=self.barrier_timeout_s)
+        except Exception as e:
+            # a failed shrink IS an incident: the run is about to lose a
+            # host AND has no continuation point — bundle before unwinding
+            self._notify_incident(e, epoch=epoch,
+                                  step=interrupt.steps_done)
+            raise
+        # the agreed leavers are handled: a stale leave file (or a
+        # monitor re-emitting 'dead' for a host that is now simply GONE)
+        # must never cascade a second shrink.  Main process also sweeps
+        # the consumed files; best-effort — _handled is the guarantee.
+        self._handled.update(manifest["leaver_hosts"])
+        if runtime.is_main_process():
+            for h in manifest["leaver_hosts"]:
+                for kind in ("leave", "dead"):
+                    try:
+                        os.remove(signal_path(self.signal_dir, kind, h))
+                    # can-tpu-lint: disable=SWALLOW(best-effort sweep of consumed signal files; _handled is the real guard)
+                    except OSError:
+                        pass
+        return manifest
+
+    def leave(self) -> int:
+        """The leaver's last act: the COORDINATED runtime teardown (every
+        member of the dying generation calls shutdown; an uncoordinated
+        exit makes the coordination service abort the survivors), then
+        hand back the preemption exit code."""
+        from can_tpu.parallel import runtime
+
+        runtime.shutdown_runtime()
+        self.close()
+        return LEAVE_EXIT_CODE
+
+    def reform(self, manifest: dict) -> dict:
+        """The survivor's re-formation: coordinated teardown WITH backend
+        reset, then a fresh runtime generation at the shrunk world.
+        Returns the new topology dict; every jax.Array of the old
+        generation is invalid past this point — restore from the shrink
+        checkpoint."""
+        from can_tpu.parallel import runtime
+
+        survivors = manifest["survivors"]
+        rank = runtime.process_index()
+        gen = runtime.generation()
+        runtime.shutdown_runtime(reset=True)
+        # env_rendezvous=False on BOTH paths: the launcher's
+        # COORDINATOR_ADDRESS/NUM_PROCESSES/SLURM/pod metadata describe
+        # the DEAD generation — re-reading them would make a lone
+        # survivor re-rendezvous the old world and wait forever for the
+        # departed rank (coordination-service abort)
+        if len(survivors) > 1:
+            coord = reform_coordinator(self.signal_dir, survivors,
+                                       generation=gen)
+            topo = runtime.init_runtime(
+                coordinator_address=coord,
+                num_processes=len(survivors),
+                process_id=survivors.index(rank),
+                env_rendezvous=False)
+        else:
+            topo = runtime.init_runtime(env_rendezvous=False)
+        # inherit the transition's host bookkeeping (rank re-numbering +
+        # handled leavers) into the new generation
+        self.adopt_manifest(manifest)
+        self.transitions += 1
+        return topo
+
+    def emit_transition(self, manifest: dict, topo: dict, *,
+                        new_dp: int, remaining: int,
+                        global_batch_new: Optional[int] = None,
+                        resumed_from: str = "in_process") -> None:
+        """One ``elastic.transition`` event (see the module-level
+        :func:`emit_transition`).  ``resumed_from`` distinguishes the
+        in-process survivor leg from a cold restart reading the same
+        manifest."""
+        if resumed_from != "in_process":
+            self.transitions += 1  # reform() already counted in-process
+        emit_transition(self.telemetry, manifest, topo, new_dp=new_dp,
+                        remaining=remaining,
+                        global_batch_new=global_batch_new,
+                        resumed_from=resumed_from)
+
+    def _notify_incident(self, exc, **context) -> None:
+        inc = (getattr(self.telemetry, "incidents", None)
+               if self.telemetry is not None else None)
+        if inc is not None:
+            inc.on_exception(exc, phase="elastic", **context)
+
+
+def emit_transition(telemetry, manifest: dict, topo: dict, *,
+                    new_dp: int, remaining: int,
+                    global_batch_new: Optional[int] = None,
+                    resumed_from: str = "in_process") -> None:
+    """One ``elastic.transition`` event — the rescaling record the
+    telemetry contract requires (rendered by obs/report.py and
+    tools/telemetry_report.py).  Module-level so a COLD restart resuming
+    from a manifest records its transition without constructing a
+    supervisor.  No-op when telemetry is None."""
+    if telemetry is None:
+        return
+    old = manifest["world_old"]
+    telemetry.emit(
+        "elastic.transition",
+        transition_id=manifest["transition_id"],
+        generation_old=manifest["generation"],
+        generation_new=topo.get("generation"),
+        epoch=manifest["epoch"],
+        steps_done=manifest["steps_done"],
+        consumed_items=len(manifest.get("consumed", ())),
+        remaining_items=int(remaining),
+        leavers=manifest.get("leavers", []),
+        reason=manifest.get("reason"),
+        processes_old=old["processes"],
+        processes_new=topo.get("process_count"),
+        dp_old=old["dp"], dp_new=int(new_dp),
+        # per-replica batch is the invariant; the global batch scales
+        # with dp — the "global-batch rescaling" record
+        global_batch_old=old["batch_size"] * old["processes"],
+        global_batch_new=global_batch_new,
+        lr_scale=int(new_dp) / max(old["dp"], 1),
+        resumed_from=resumed_from,
+    )
+
+
+def _local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()

@@ -1,0 +1,15 @@
+from .faults import (
+    FAULTS_ENV,
+    FaultInjector,
+    InjectedFault,
+    active_injector,
+    make_kill_schedule,
+)
+
+__all__ = [
+    "FAULTS_ENV",
+    "FaultInjector",
+    "InjectedFault",
+    "active_injector",
+    "make_kill_schedule",
+]

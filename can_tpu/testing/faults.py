@@ -1,0 +1,184 @@
+"""Deterministic fault injection: the test harness elasticity needs.
+
+Elastic shrink-and-continue (parallel/elastic.py) is untestable without
+controlled failure: "a host dies mid-epoch" must be reproducible to the
+step, or the chaos test (tests/test_multiprocess.py) proves nothing and
+flakes forever.  This module delivers a SEEDED, explicit fault schedule
+to real processes through an environment trigger, so a subprocess worker
+can be killed at exactly step s of epoch e, a checkpoint write can fail
+exactly n times, and a rendezvous barrier can be held past its timeout —
+with zero cost and zero code reached when the env var is unset.
+
+Delivery: ``CAN_TPU_FAULTS`` holds either inline JSON or a path to a
+JSON file (the file trigger lets a driver write the schedule once and
+point every worker at it).  Schema::
+
+    {"faults": [
+        {"kind": "kill", "rank": 1, "step": 3, "epoch": 0,
+         "signal": "SIGTERM"},
+        {"kind": "ckpt_io", "op": "save", "fails": 2, "rank": 0},
+        {"kind": "rendezvous_timeout", "barrier": "elastic", "rank": 1,
+         "delay_s": 30.0}
+    ]}
+
+* ``kill`` — at the matching (rank, epoch, step) boundary the injector
+  sends the named signal to ITS OWN process (default SIGTERM: the
+  preemption notice, so the real grace-window choreography — incident
+  bundle, leave announcement, coordinated shutdown — runs exactly as it
+  would under a preemptor; SIGKILL for the no-grace hard-death case).
+* ``ckpt_io`` — the first ``fails`` attempts of the matching checkpoint
+  op raise ``InjectedFault`` (an OSError: the transient-FS class the
+  retry/backoff in utils/checkpoint.py absorbs; set ``fails`` above the
+  retry budget to exercise the typed ``CheckpointIOError`` give-up).
+* ``rendezvous_timeout`` — the matching rank holds the matching barrier
+  for ``delay_s`` before joining, so every OTHER member's bounded
+  ``barrier()`` times out for real and raises the typed
+  ``RendezvousTimeoutError`` (parallel/runtime.py).
+
+Hooks are consulted only from sites that already gate on
+``active_injector()`` (train-loop elastic hook, checkpoint retry loop,
+``runtime.barrier``) — a production run without the env var never
+constructs an injector.
+
+``make_kill_schedule`` derives the kill step from a seed (the "seeded
+schedule of kill-rank-k-at-step-s"): chaos runs randomise WHERE the
+fault lands across seeds while any single seed reproduces exactly.
+
+jax-free by design: importable by workers before jax initialises and by
+host-side tools.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import time
+from typing import Dict, List, Optional
+
+FAULTS_ENV = "CAN_TPU_FAULTS"
+
+
+class InjectedFault(OSError):
+    """A fault the schedule asked for (OSError: checkpoint-I/O faults
+    must look like the transient filesystem errors the retry path
+    handles)."""
+
+
+def make_kill_schedule(seed: int, *, rank: int, max_step: int,
+                       epoch: int = 0, min_step: int = 1,
+                       sig: str = "SIGTERM") -> dict:
+    """A one-kill schedule whose step is drawn from ``seed`` — different
+    seeds move the preemption around the epoch, one seed reproduces
+    bit-exactly.  Pure arithmetic (no numpy): workers import this before
+    heavyweight deps."""
+    if max_step < min_step:
+        raise ValueError(f"max_step {max_step} < min_step {min_step}")
+    # sha256 of the full key: well-mixed and deterministic across
+    # platforms/processes (a cheap LCG scramble had degenerate low bits)
+    import hashlib
+
+    digest = hashlib.sha256(
+        f"can_tpu.faults:{seed}:{rank}:{epoch}".encode()).digest()
+    x = int.from_bytes(digest[:8], "big")
+    step = min_step + x % (max_step - min_step + 1)
+    return {"faults": [{"kind": "kill", "rank": int(rank),
+                        "epoch": int(epoch), "step": int(step),
+                        "signal": sig}]}
+
+
+class FaultInjector:
+    """Parsed fault schedule + per-site hooks.  Construct via
+    :func:`active_injector` (env-gated) or directly in unit tests."""
+
+    def __init__(self, spec: dict):
+        faults = spec.get("faults")
+        if not isinstance(faults, list):
+            raise ValueError(
+                "fault schedule must be {'faults': [...]}; got "
+                f"{type(spec).__name__} without a fault list")
+        self.faults: List[dict] = []
+        for f in faults:
+            if not isinstance(f, dict) or "kind" not in f:
+                raise ValueError(f"malformed fault entry: {f!r}")
+            if f["kind"] not in ("kill", "ckpt_io", "rendezvous_timeout"):
+                raise ValueError(f"unknown fault kind {f['kind']!r}")
+            self.faults.append(dict(f))
+        self._ckpt_attempts: Dict[str, int] = {}
+        self.fired: List[dict] = []  # delivered faults, for assertions
+
+    # -- hooks ------------------------------------------------------------
+    def on_step(self, step: int, *, epoch: int = 0,
+                rank: int = 0) -> None:
+        """Train-loop boundary: deliver any matching ``kill`` by
+        signalling OUR OWN process — the real handler chain (incident
+        bundle, elastic leave flag) runs, exactly like an external
+        preemptor's notice."""
+        for f in self.faults:
+            if (f["kind"] == "kill" and not f.get("_fired")
+                    and int(f.get("rank", 0)) == rank
+                    and int(f.get("epoch", 0)) == epoch
+                    and int(f.get("step", 0)) == step):
+                f["_fired"] = True
+                self.fired.append(f)
+                signum = getattr(_signal,
+                                 str(f.get("signal", "SIGTERM")))
+                os.kill(os.getpid(), signum)
+
+    def on_ckpt_io(self, op: str, *, rank: int = 0) -> None:
+        """Checkpoint save/restore attempt: raise for the first ``fails``
+        matching attempts (utils/checkpoint.py consults this inside its
+        retry loop — passing its real process index — so the backoff
+        path is exercised for real).  A fault entry WITHOUT ``rank``
+        fires on every process; with one, only on that rank."""
+        for i, f in enumerate(self.faults):
+            if f["kind"] != "ckpt_io" or f.get("op", "save") != op:
+                continue
+            frank = f.get("rank")
+            if frank is not None and int(frank) != rank:
+                continue
+            key = f"{i}:{op}"
+            n = self._ckpt_attempts.get(key, 0) + 1
+            self._ckpt_attempts[key] = n
+            if n <= int(f.get("fails", 1)):
+                self.fired.append(f)
+                raise InjectedFault(
+                    f"injected checkpoint {op} I/O error "
+                    f"(attempt {n}/{f.get('fails', 1)})")
+
+    def on_barrier(self, name: str, *, rank: int = 0) -> None:
+        """Barrier entry: the matching rank HOLDS the barrier for
+        ``delay_s`` — every other member's bounded wait then times out
+        for real (runtime.barrier consults this before joining)."""
+        for f in self.faults:
+            if (f["kind"] == "rendezvous_timeout" and not f.get("_fired")
+                    and int(f.get("rank", 0)) == rank
+                    and str(f.get("barrier", "")) in name):
+                f["_fired"] = True
+                self.fired.append(f)
+                time.sleep(float(f.get("delay_s", 30.0)))
+
+
+_CACHED: Optional[FaultInjector] = None
+_CACHED_SPEC: Optional[str] = None
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The process's injector, or None when ``CAN_TPU_FAULTS`` is unset —
+    the one gate every production hook site checks.  The parsed injector
+    is cached per spec value (attempt counters must persist across
+    hook calls); a malformed schedule raises loudly at the FIRST hook
+    rather than silently running the chaos test without its chaos."""
+    global _CACHED, _CACHED_SPEC
+    spec = os.environ.get(FAULTS_ENV, "")
+    if not spec:
+        return None
+    if _CACHED is not None and spec == _CACHED_SPEC:
+        return _CACHED
+    text = spec
+    if not spec.lstrip().startswith("{"):
+        with open(spec) as f:  # a path trigger
+            text = f.read()
+    _CACHED = FaultInjector(json.loads(text))
+    _CACHED_SPEC = spec
+    return _CACHED

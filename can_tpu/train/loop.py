@@ -26,6 +26,7 @@ from typing import Callable, Iterable, NamedTuple, Optional
 import jax
 import numpy as np
 
+from can_tpu.parallel.elastic import ElasticInterrupt
 from can_tpu.train.steps import NonFiniteLossError
 
 
@@ -129,10 +130,15 @@ def _notify_incident(telemetry, exc, *, phase: str, epoch: int,
     still exists.  ``NonFiniteLossError`` is deliberately NOT routed
     here: its bundle was already dumped by the ``health.alert`` nan
     trigger inside ``_flush``, and a second one would double-report the
-    same death.  No-op (one getattr) when incidents are unarmed."""
+    same death.  ``ElasticInterrupt`` is excluded too: an agreed shrink
+    is CONTROL FLOW — the preemption's bundle belongs to the leaver's
+    SIGTERM hook, and a per-survivor exception bundle would multiply one
+    fleet event into N incidents.  No-op (one getattr) when incidents
+    are unarmed."""
     inc = (getattr(telemetry, "incidents", None)
            if telemetry is not None else None)
-    if inc is not None and not isinstance(exc, NonFiniteLossError):
+    if inc is not None and not isinstance(exc, (NonFiniteLossError,
+                                                ElasticInterrupt)):
         inc.on_exception(exc, phase=phase, epoch=epoch, step=step)
 
 
@@ -160,7 +166,7 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                     put_fn: Callable, epoch: int = 0, show_progress: bool = True,
                     check_finite: bool = True, total: Optional[int] = None,
                     prefetch: int = 2, check_every: int = 8, telemetry=None,
-                    health=None):
+                    health=None, on_step: Optional[Callable] = None):
     """Run one epoch; returns (state, EpochStats).
 
     train_step: jitted (state, batch_dict) -> (state, metrics).
@@ -181,6 +187,13 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
       epoch's stall fraction — emitting ``health.alert`` events on the
       same bus.  Requires ``telemetry`` (ignored without it): detection
       rides the windowed fetch, never adds a sync.
+    on_step: optional callable(step_count) run after each completed step
+      — the elastic supervisor's hook (fault delivery + preemption
+      agreement, parallel/elastic.py).  An ``ElasticInterrupt`` it
+      raises gets the LIVE post-step train state attached
+      (``exc.state``/``exc.steps_done``) before unwinding, so the caller
+      can checkpoint the exact mid-epoch point; None (the default)
+      keeps the hot path untouched.
     """
     from can_tpu.data.prefetch import prefetch_to_device
 
@@ -226,6 +239,8 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                            record=not train_step.last_first_call)
             pending.append(metrics)
             steps += 1
+            if on_step is not None:
+                on_step(steps)
             if len(pending) >= max(check_every, 1):
                 t_flush = (time.perf_counter()
                            if telemetry is not None else 0.0)
@@ -262,9 +277,15 @@ def train_one_epoch(train_step: Callable, state, batches: Iterable, *,
                                         health=health,
                                         collect=telemetry is not None)
     except Exception as e:
+        if isinstance(e, ElasticInterrupt):
+            # an agreed shrink: hand the caller the LIVE mid-epoch state
+            # (post-step) — the shrink checkpoint must save exactly this
+            # point or "resume from the exact step" is a lie
+            e.state = state
+            e.steps_done = steps
         # the incident hook (a crashed loader thread, a poisoned batch,
-        # an XLA error): bundle first, THEN unwind — the NaN abort path
-        # is excluded inside (its bundle rode the health.alert)
+        # an XLA error): bundle first, THEN unwind — the NaN abort and
+        # elastic-shrink paths are excluded inside
         _notify_incident(telemetry, e, phase="train", epoch=epoch,
                          step=steps)
         raise

@@ -1,5 +1,6 @@
 from .checkpoint import (
     SERVE_CONFIG_KEYS,
+    CheckpointIOError,
     CheckpointManager,
     ConfigDriftError,
     check_resume_config,
@@ -19,6 +20,7 @@ from .profiling import (
 )
 
 __all__ = [
+    "CheckpointIOError",
     "CheckpointManager",
     "ConfigDriftError",
     "SERVE_CONFIG_KEYS",

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import time
 from typing import List, Optional
 
 from can_tpu.train.state import TrainState
@@ -22,6 +24,22 @@ RUN_CONFIG_NAME = "run_config.json"
 
 class ConfigDriftError(ValueError):
     """A schedule-bearing flag differs from the checkpoint's run config."""
+
+
+class CheckpointIOError(OSError):
+    """Checkpoint save/restore I/O failed past the retry budget.
+
+    Typed so the one path where losing the checkpoint loses the RUN (the
+    elastic shrink-window save — after it the old world is torn down) can
+    route the failure to an incident bundle instead of dying as an
+    anonymous OSError.  Carries ``op`` and ``attempts``."""
+
+    def __init__(self, op: str, attempts: int, cause: BaseException):
+        self.op = op
+        self.attempts = attempts
+        super().__init__(
+            f"checkpoint {op} failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause}")
 
 
 def save_run_config(directory: str, config: dict) -> str:
@@ -83,16 +101,31 @@ def check_serve_config(serving: dict, incoming: dict, *,
     return check_resume_config(sub, cur, allow=allow)
 
 
+# the keys an ELASTIC transition legitimately changes: the world shrank,
+# so dp (hence lr peak and global batch, both derived from it) differs by
+# construction.  Everything else — lr base, lrf, epochs, per-replica
+# batch, seed, model variant, dtype — must still match exactly: elastic
+# is a world change, never a licence for schedule drift.
+ELASTIC_DRIFT_KEYS = ("world_size",)
+
+
 def check_resume_config(saved: dict, current: dict, *,
-                        allow: bool = False) -> List[str]:
+                        allow: bool = False,
+                        allow_elastic: bool = False) -> List[str]:
     """Compare a checkpoint's saved run config against the resuming run's.
 
     Returns the sorted list of drifted keys; raises
     :class:`ConfigDriftError` naming each ``key: saved -> current`` unless
-    ``allow`` (the CLI's ``--allow-config-change``)."""
+    ``allow`` (the CLI's ``--allow-config-change``) — or the drift is
+    confined to :data:`ELASTIC_DRIFT_KEYS` and ``allow_elastic`` (an
+    elastic transition manifest is live for this checkpoint dir, or the
+    run opted into elasticity): a dp-only change then resumes cleanly
+    while any REAL config drift still errors."""
     keys = sorted(set(saved) | set(current))
     drifted = [k for k in keys if saved.get(k) != current.get(k)]
     if drifted and not allow:
+        if allow_elastic and all(k in ELASTIC_DRIFT_KEYS for k in drifted):
+            return drifted
         detail = ", ".join(f"{k}: {saved.get(k)!r} -> {current.get(k)!r}"
                            for k in drifted)
         raise ConfigDriftError(
@@ -101,12 +134,29 @@ def check_resume_config(saved: dict, current: dict, *,
 
 
 class CheckpointManager:
-    """Best-metric + latest checkpointing of TrainState under ``directory``."""
+    """Best-metric + latest checkpointing of TrainState under ``directory``.
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3):
+    Save/restore I/O retries transient filesystem errors with
+    exponential backoff + jitter (``retries``/``backoff_s``): on shared
+    storage a brief NFS/GCS hiccup during the elastic shrink-window save
+    used to propagate as a fatal on the one path where losing the
+    checkpoint loses the run.  Exhausted retries raise the typed
+    :class:`CheckpointIOError` (callers route it to an incident bundle).
+    The jitter is real randomness, not seeded — it desynchronises HOSTS
+    retrying against one overloaded filesystem and never touches
+    training numerics."""
+
+    #: transient classes worth retrying; anything else (a shape mismatch,
+    #: a wrong tree structure) fails immediately and loudly
+    TRANSIENT = (OSError, IOError, TimeoutError)
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 retries: int = 3, backoff_s: float = 0.25):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self.retries = max(1, int(retries))
+        self.backoff_s = float(backoff_s)
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         # best_fn/best_mode drive best_step() selection; RETENTION is the
@@ -139,6 +189,41 @@ class CheckpointManager:
         self.manager = ocp.CheckpointManager(
             self.directory, options=ocp.CheckpointManagerOptions(**opt_kwargs))
 
+    def _with_retries(self, op: str, fn):
+        """Run one checkpoint I/O op with backoff+jitter retries on the
+        TRANSIENT classes.  The deterministic fault harness
+        (can_tpu/testing/faults.py, env-gated) injects its scheduled
+        ``ckpt_io`` errors INSIDE the attempt, so the retry path is
+        exercised by real failures in the chaos tests."""
+        from can_tpu.testing.faults import active_injector
+
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.retries + 1):
+            try:
+                inj = active_injector()
+                if inj is not None:
+                    import jax
+
+                    inj.on_ckpt_io(op, rank=jax.process_index())
+                return fn()
+            except FileNotFoundError:
+                # an OSError subclass, but never transient: a missing
+                # checkpoint is a retention/path condition — retrying
+                # and re-typing it would send the operator chasing
+                # filesystem flakiness instead of the real mismatch
+                raise
+            except self.TRANSIENT as e:
+                last = e
+                if attempt < self.retries:
+                    delay = (self.backoff_s * (2 ** (attempt - 1))
+                             * (1.0 + random.random()))
+                    print(f"[checkpoint] transient {op} failure "
+                          f"(attempt {attempt}/{self.retries}): "
+                          f"{type(e).__name__}: {e} — retrying in "
+                          f"{delay:.2f}s", flush=True)
+                    time.sleep(delay)
+        raise CheckpointIOError(op, self.retries, last) from last
+
     def save(self, epoch: int, state: TrainState, *, mae: float,
              extra: Optional[dict] = None) -> bool:
         """Save if this epoch's MAE is among the best (reference policy:
@@ -146,8 +231,8 @@ class CheckpointManager:
         metrics = {"mae": float(mae)}
         if extra:
             metrics.update({k: float(v) for k, v in extra.items()})
-        saved = self.manager.save(
-            epoch, args=self._ocp.args.StandardSave(state), metrics=metrics)
+        saved = self._with_retries("save", lambda: self.manager.save(
+            epoch, args=self._ocp.args.StandardSave(state), metrics=metrics))
         return bool(saved)
 
     def restore(self, state: TrainState, *, epoch: Optional[int] = None) -> TrainState:
@@ -156,8 +241,8 @@ class CheckpointManager:
             epoch = self.manager.latest_step()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        return self.manager.restore(
-            epoch, args=self._ocp.args.StandardRestore(state))
+        return self._with_retries("restore", lambda: self.manager.restore(
+            epoch, args=self._ocp.args.StandardRestore(state)))
 
     def latest_epoch(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -180,7 +265,20 @@ class CheckpointManager:
             return None
 
     def wait(self) -> None:
-        self.manager.wait_until_finished()
+        """Block for in-flight async saves.  TYPED but deliberately NOT
+        retried: async Orbax write errors SURFACE here and the elastic
+        shrink path needs them as ``CheckpointIOError`` (→ incident
+        bundle) — but a retry cannot re-run the failed background write,
+        and if the consumed future's error state were cleared, a second
+        ``wait_until_finished`` returning cleanly would convert a LOST
+        checkpoint into silent success on the one path where that loses
+        the run."""
+        try:
+            self.manager.wait_until_finished()
+        except FileNotFoundError:
+            raise  # never transient (see _with_retries)
+        except self.TRANSIENT as e:
+            raise CheckpointIOError("wait", 1, e) from e
 
     def close(self) -> None:
         self.manager.close()

@@ -1,0 +1,231 @@
+"""Worker for the elastic shrink-and-continue chaos test
+(test_multiprocess.py::test_elastic_shrink_and_continue).
+
+Leg A (mode ``elastic1``, 2 processes x 4 virtual CPU devices): dp=8
+training with the full preemption stack armed — flight recorder +
+IncidentManager SIGTERM hook, elastic supervisor (signal hook installed
+FIRST so the bundle dump chains into the leaving flag), and the seeded
+fault schedule from ``CAN_TPU_FAULTS`` which SIGTERMs rank 1 at a
+scheduled mid-epoch step.  The choreography then runs for real:
+
+  rank 1: preemption bundle dumped -> leaving flag -> keeps lockstep ->
+          agreement allgather -> ElasticInterrupt -> shrink checkpoint
+          at the barrier -> coordinated shutdown -> exit 143
+  rank 0: agreement allgather (same step) -> ElasticInterrupt -> shrink
+          checkpoint -> reform (backend reset + single-process re-init,
+          generation 2) -> restore -> replan the epoch's REMAINING items
+          at dp'=4 -> emit elastic.transition -> train the remainder ->
+          eval -> write results -> exit 0
+
+Leg B (mode ``elastic2``, 1 fresh process x 4 devices): a COLD restart
+reading the same checkpoint dir: load the elastic manifest, restore the
+shrink checkpoint, build the identical dp'=4 world and remainder plan,
+train, eval, write results.  The chaos test asserts leg A's post-shrink
+numbers are BIT-IDENTICAL to leg B's — the resume leg is one code path,
+whether entered in-process or from a cold start.
+
+Usage: python tests/elastic_worker.py <mode> <rank> <nprocs> <port> <out_dir>
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+SEED = 3
+N_TRAIN = 32  # 4 steps @ gbs 8: a kill drawn in [1, 2] interrupts by
+#               step 3 at the latest, so the remainder is NEVER empty
+HOST_BATCH_2P = 4   # per-host @ 2 procs -> global batch 8 (dp=8, 1/replica)
+HOST_BATCH_1P = 4   # per-host @ 1 proc  -> global batch 4 (dp=4, 1/replica)
+EVAL_BATCH = 4
+
+
+def build_world(out_dir, *, host_batch, process_index, process_count, dp):
+    from can_tpu.data import CrowdDataset, ShardedBatcher
+    from can_tpu.models import cannet_apply, cannet_init
+    from can_tpu.parallel import make_dp_eval_step, make_dp_train_step, \
+        make_global_batch, make_mesh
+    from can_tpu.train import create_train_state, make_lr_schedule, \
+        make_optimizer
+
+    ds = CrowdDataset(os.path.join(out_dir, "data", "images"),
+                      os.path.join(out_dir, "data", "ground_truth"),
+                      gt_downsample=8, phase="train")
+    mesh = make_mesh(jax.devices()[:dp])
+    # lr follows the linear scaling rule: world_size = dp of THIS
+    # generation — the elastic rescale is "rebuild the schedule at dp'"
+    opt = make_optimizer(make_lr_schedule(1e-7, world_size=dp))
+    state = create_train_state(cannet_init(jax.random.key(0)), opt)
+    batcher = ShardedBatcher(ds, host_batch, shuffle=True, seed=SEED,
+                             process_index=process_index,
+                             process_count=process_count)
+    step = make_dp_train_step(cannet_apply, opt, mesh)
+    eval_step = make_dp_eval_step(cannet_apply, mesh)
+    put = lambda b: make_global_batch(b, mesh)  # noqa: E731
+    return ds, mesh, state, batcher, step, eval_step, put
+
+
+def resumed_leg(out_dir, manifest, telemetry, supervisor, resumed_from):
+    """The shared post-transition path: restore the shrink checkpoint at
+    dp'=4, replan the remainder, train it, eval, write bit-comparable
+    results.  Identical for the in-process survivor and the cold
+    restart — which is exactly what the chaos test pins."""
+    import numpy as np
+
+    from can_tpu.data import CrowdDataset, ShardedBatcher
+    from can_tpu.data.planner import schedule_coverage
+    from can_tpu.parallel import elastic as el
+    from can_tpu.parallel import process_count, shutdown_runtime
+    from can_tpu.parallel.runtime import generation
+    from can_tpu.train import evaluate, train_one_epoch
+    from can_tpu.utils import CheckpointManager
+
+    ck = os.path.join(out_dir, "ck")
+    ds, mesh, state, batcher, step, eval_step, put = build_world(
+        out_dir, host_batch=HOST_BATCH_1P, process_index=0,
+        process_count=1, dp=4)
+    emgr = CheckpointManager(os.path.join(ck, el.ELASTIC_SUBDIR))
+    try:
+        state = emgr.restore(state, epoch=int(manifest["transition_id"]))
+    finally:
+        emgr.close()
+    epoch = int(manifest["epoch"])
+    remaining = el.remaining_items(manifest, len(ds))
+    # exact once-per-epoch coverage across the transition: consumed and
+    # the replanned remainder partition the epoch
+    sched = batcher.global_schedule(epoch, set(remaining))
+    cov = schedule_coverage(sched)
+    assert cov == {i: 1 for i in remaining}, (
+        f"remainder replan covers {len(cov)} items, wanted "
+        f"{len(remaining)} exactly once")
+    consumed = set(int(i) for i in manifest["consumed"])
+    assert consumed | set(remaining) == set(range(len(ds)))
+    assert not (consumed & set(remaining))
+
+    topo_now = {"generation": generation(), "process_count": process_count()}
+    if supervisor is not None:
+        supervisor.emit_transition(manifest, topo_now, new_dp=4,
+                                   remaining=len(remaining),
+                                   global_batch_new=HOST_BATCH_1P,
+                                   resumed_from=resumed_from)
+    else:
+        el.emit_transition(telemetry, manifest, topo_now, new_dp=4,
+                           remaining=len(remaining),
+                           global_batch_new=HOST_BATCH_1P,
+                           resumed_from=resumed_from)
+    state, stats = train_one_epoch(step, state,
+                                   batcher.epoch(epoch, set(remaining)),
+                                   put_fn=put, show_progress=False)
+    assert stats.images == len(remaining), (stats.images, len(remaining))
+
+    eval_ds = CrowdDataset(os.path.join(out_dir, "data", "images"),
+                           os.path.join(out_dir, "data", "ground_truth"),
+                           gt_downsample=8, phase="test")
+    eval_batcher = ShardedBatcher(eval_ds, EVAL_BATCH, shuffle=False)
+    metrics = evaluate(eval_step, state.params, eval_batcher.epoch(0),
+                       put_fn=put, dataset_size=eval_batcher.dataset_size)
+    tag = "a" if resumed_from == "in_process" else "b"
+    with open(os.path.join(out_dir, f"resumed_{tag}.json"), "w") as f:
+        json.dump({
+            # float hex: BIT-identity comparison, not approx
+            "loss": float(stats.loss).hex(),
+            "mae": float(metrics["mae"]).hex(),
+            "mse": float(metrics["mse"]).hex(),
+            "steps": stats.steps,
+            "images": stats.images,
+            "remaining": len(remaining),
+            "epoch": epoch,
+        }, f)
+    if telemetry is not None:
+        telemetry.close()
+    shutdown_runtime()
+    return 0
+
+
+def main():
+    mode, rank, nprocs, port, out_dir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5])
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from can_tpu import obs
+    from can_tpu.parallel import elastic as el
+    from can_tpu.parallel import init_runtime
+    from can_tpu.parallel.elastic import ElasticInterrupt, ElasticSupervisor
+    from can_tpu.train import train_one_epoch
+
+    signal_dir = os.path.join(out_dir, "elastic")
+    incident_dir = os.path.join(out_dir, "incidents")
+    ck = os.path.join(out_dir, "ck")
+
+    if mode == "elastic2":
+        # leg B: cold restart at dp'=4 from leg A's shrink checkpoint
+        init_runtime()
+        manifest = el.load_manifest(ck)
+        assert manifest is not None, "no live elastic manifest in ck/"
+        return resumed_leg(out_dir, manifest, None, None, "cold_restart")
+
+    assert mode == "elastic1", mode
+    topo = init_runtime(coordinator_address=f"localhost:{port}",
+                        num_processes=nprocs, process_id=rank)
+    assert topo["process_count"] == nprocs, topo
+    assert topo["global_devices"] == 8, topo
+
+    # ORDER MATTERS twice over: the hooks go in AFTER init_runtime (the
+    # distributed client registers XLA's own SIGTERM preemption notifier
+    # at initialize, clobbering anything installed earlier), and the
+    # supervisor's hook goes in BEFORE the incident manager's — the
+    # manager dumps the preemption bundle and CHAINS to the supervisor
+    # hook (leaving flag) instead of SystemExit
+    supervisor = ElasticSupervisor(signal_dir, check_every=1)
+    supervisor.install_signal_hook()
+    recorder = obs.FlightRecorder()
+    telemetry = obs.open_host_telemetry(os.path.join(out_dir, "telemetry"),
+                                        host_id=rank,
+                                        extra_sinks=[recorder])
+    manager = obs.IncidentManager(telemetry, recorder,
+                                  incident_dir=incident_dir, host_id=rank)
+    telemetry.watchers.append(manager)
+    telemetry.incidents = manager
+    obs.install_sigterm_handler(manager)
+    supervisor.telemetry = telemetry
+
+    ds, mesh, state, batcher, step, eval_step, put = build_world(
+        out_dir, host_batch=HOST_BATCH_2P, process_index=rank,
+        process_count=nprocs, dp=8)
+    try:
+        state, _stats = train_one_epoch(
+            step, state, batcher.epoch(0), put_fn=put, show_progress=False,
+            on_step=supervisor.step_hook(0))
+    except ElasticInterrupt as interrupt:
+        manifest = supervisor.shrink(
+            interrupt, state=interrupt.state, epoch=0, checkpoint_dir=ck,
+            schedule=batcher.global_schedule(0), dp=8, sp=1,
+            batch_size=HOST_BATCH_2P)
+        with open(os.path.join(out_dir, f"shrink_{rank}.json"), "w") as f:
+            json.dump({"steps_done": interrupt.steps_done,
+                       "leavers": sorted(interrupt.leavers),
+                       "consumed": len(manifest["consumed"])}, f)
+        batcher.close()
+        if rank in manifest["leavers"]:
+            rc = supervisor.leave()
+            telemetry.close()
+            sys.exit(rc)
+        # survivor: re-form at the shrunk world and continue in-process
+        supervisor.reform(manifest)
+        return resumed_leg(out_dir, manifest, telemetry, supervisor,
+                           "in_process")
+    raise AssertionError(
+        "epoch finished without an elastic interrupt — the injected "
+        "fault never fired (check CAN_TPU_FAULTS)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,122 @@ def test_two_process_checkpoint_cycle_agrees(tmp_path):
     assert mae2 == pytest.approx((metrics["mae"], metrics["mse"]), rel=1e-4)
 
 
+def test_elastic_shrink_and_continue(tmp_path):
+    """The elastic chaos test (ISSUE 12 acceptance): a SEEDED fault
+    SIGTERMs 1 of 2 real workers mid-epoch.  The victim dumps exactly one
+    preemption incident bundle and leaves cleanly (exit 143); both ranks
+    agree the shrink at the same lockstep step, checkpoint at the bounded
+    barrier, and the survivor re-rendezvouses at dp'=4 (generation 2),
+    replans the epoch's remaining items, and continues — recording
+    exactly one elastic.transition event.  Its post-shrink loss/MAE/MSE
+    must be BIT-IDENTICAL (float hex) to a cold restart from the same
+    shrink checkpoint at dp'=4: the resume leg is one code path whether
+    entered in-process or from a fresh process."""
+    import glob
+    import json
+
+    from can_tpu.obs.incidents import read_manifest
+    from can_tpu.obs.report import read_events
+    from can_tpu.testing.faults import make_kill_schedule
+
+    make_synthetic_dataset(str(tmp_path / "data"), 32,
+                           sizes=((64, 64),), seed=3)
+    # seeded kill: rank 1, some step in [1, 2] of the 4-step epoch —
+    # always MID-epoch, reproducible per seed
+    faults = make_kill_schedule(11, rank=1, max_step=2, min_step=1)
+    fault_file = tmp_path / "faults.json"
+    fault_file.write_text(json.dumps(faults))
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["CAN_TPU_FAULTS"] = str(fault_file)
+    worker = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+    logs = [open(tmp_path / f"worker_{r}.log", "wb") for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, "elastic1", str(rank), "2",
+             str(port), str(tmp_path)],
+            env=env, stdout=logs[rank], stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        for rank in range(2)
+    ]
+    try:
+        for p in procs:
+            p.wait(timeout=600)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in logs:
+            f.close()
+    outs = [(tmp_path / f"worker_{r}.log").read_bytes().decode()
+            for r in range(2)]
+    # survivor finishes cleanly; the preempted rank leaves with 143
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0][-3000:]}"
+    assert procs[1].returncode == 143, (
+        f"victim rc {procs[1].returncode}:\n{outs[1][-3000:]}")
+
+    # both ranks agreed the SAME shrink point and leaver set
+    shrinks = [json.loads((tmp_path / f"shrink_{r}.json").read_text())
+               for r in range(2)]
+    assert shrinks[0] == shrinks[1]
+    assert shrinks[0]["leavers"] == [1]
+    kill_step = faults["faults"][0]["step"]
+    assert shrinks[0]["steps_done"] >= kill_step
+    assert shrinks[0]["consumed"] == shrinks[0]["steps_done"] * 8
+
+    # exactly ONE preemption incident bundle (the victim's SIGTERM dump)
+    bundles = [read_manifest(b) for b in
+               glob.glob(str(tmp_path / "incidents" / "incident-*"))]
+    bundles = [m for m in bundles if m is not None]
+    assert len(bundles) == 1, [m["reason"] for m in bundles]
+    assert bundles[0]["reason"] == "signal_sigterm"
+    assert bundles[0]["severity"] == "preemption"
+    assert bundles[0]["host_id"] == 1
+
+    # exactly ONE elastic.transition recorded, by the survivor
+    events = []
+    for path in glob.glob(str(tmp_path / "telemetry" / "*.jsonl")):
+        events += [e for e in read_events(path)
+                   if e.get("kind") == "elastic.transition"]
+    assert len(events) == 1, events
+    t = events[0]["payload"]
+    assert (t["processes_old"], t["processes_new"]) == (2, 1)
+    assert (t["dp_old"], t["dp_new"]) == (8, 4)
+    assert t["lr_scale"] == 0.5
+    assert t["global_batch_old"] == 8 and t["global_batch_new"] == 4
+    assert t["consumed_items"] + t["remaining_items"] == 32
+    assert t["remaining_items"] > 0  # the shrink was genuinely MID-epoch
+    assert t["resumed_from"] == "in_process"
+
+    # the elastic manifest is live and consistent
+    from can_tpu.parallel import elastic as el
+
+    manifest = el.load_manifest(str(tmp_path / "ck"))
+    assert manifest is not None
+    assert manifest["leavers"] == [1]
+    assert len(manifest["consumed"]) == shrinks[0]["consumed"]
+
+    # leg B: cold restart from the same shrink checkpoint at dp'=4
+    env_b = {k: v for k, v in os.environ.items()
+             if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    with open(tmp_path / "worker_b.log", "wb") as log_b:
+        rc = subprocess.call(
+            [sys.executable, worker, "elastic2", "0", "1", "0",
+             str(tmp_path)],
+            env=env_b, stdout=log_b, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert rc == 0, (tmp_path / "worker_b.log").read_bytes().decode()[-3000:]
+
+    a = json.loads((tmp_path / "resumed_a.json").read_text())
+    b = json.loads((tmp_path / "resumed_b.json").read_text())
+    # BIT-identical continuation: float hex equality, not approx
+    assert a == b, f"in-process vs cold-restart legs diverged:\n{a}\n{b}"
+    assert a["remaining"] == 32 - shrinks[0]["consumed"]
+
+
 def test_two_process_remnant_schedule_agrees(tmp_path):
     """r4 planner across real OS-process boundaries: a variable-resolution
     dataset under the auto ladder + remnant sub-batches (incl. sub-full

@@ -35,6 +35,16 @@
 #       around (unlike the perf/bn/fleet tiers below).
 #       CI_SLO_FIXTURE / CI_SLO_SPEC override the pair.
 #
+#   CI_BENCH_ONLY=elastic tools/ci_bench_gate.sh
+#       gates elastic shrink-and-continue: runs the fault-injected
+#       2-process chaos test (a seeded SIGTERM kills 1 of 2 real workers
+#       mid-epoch; the survivor checkpoints at the bounded barrier,
+#       re-rendezvouses at dp'=4, replans the remaining items, and must
+#       continue BIT-identically to a cold restart from the shrink
+#       checkpoint — with exactly one preemption bundle and one
+#       elastic.transition event) on the forced cpu8 platform, same
+#       pattern as the fleet tier.  No artifact: pass/fail IS the gate.
+#
 # Environment knobs:
 #   CI_BENCH_OUT           where the fresh run's records land
 #                          (default /tmp/ci_bench_suite.jsonl)
@@ -61,6 +71,17 @@ if [ "$ONLY" = "slo" ]; then
     exec python tools/slo_report.py \
         "${CI_SLO_FIXTURE:-SLO_FIXTURE_cpu_r12.jsonl}" \
         --spec "${CI_SLO_SPEC:-slo_spec.json}"
+fi
+
+# the elastic tier runs the REAL 2-process shrink choreography under a
+# seeded injected fault (slow-marked, so tier-1 never pays for it); the
+# workers pin their own cpu platform + 4 virtual devices each (= the
+# cpu8 world), like the fleet tier forces cpu8
+if [ "$ONLY" = "elastic" ]; then
+    cd "$(dirname "$0")/.."
+    exec python -m pytest \
+        tests/test_multiprocess.py::test_elastic_shrink_and_continue \
+        -q -p no:cacheprovider
 fi
 
 # the fleet tier pins one device per replica; on the CPU gate box that

@@ -51,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from can_tpu.obs.incidents import MANIFEST_NAME, read_manifest  # noqa: E402
 from can_tpu.obs.report import read_events_counted  # noqa: E402
+from can_tpu.obs.signals import write_signal  # noqa: E402
 
 _HOST_RE = re.compile(r"telemetry\.host(\d+)\.jsonl$")
 
@@ -319,6 +320,24 @@ def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
                             incident_window_s=incident_window_s)
 
 
+def emit_dead_signals(run: dict, signal_dir: str) -> list:
+    """Write one machine-readable ``dead`` signal file per dead-host
+    finding (obs/signals.py format — the SAME files the elastic
+    supervisor polls from its step hook, so detection and reaction
+    compose without a new daemon: this monitor finds the stale
+    heartbeat, the surviving hosts' supervisors shrink around it).
+    Idempotent per host (atomic overwrite); returns the paths written."""
+    paths = []
+    for hid in run.get("dead", ()):
+        h = run["hosts"].get(hid, {})
+        paths.append(write_signal(
+            signal_dir, kind="dead", host_id=hid,
+            reason="heartbeat_stale",
+            detail={"staleness_s": h.get("staleness_s"),
+                    "source": "run_monitor"}))
+    return paths
+
+
 def _fmt_s(v) -> str:
     return "-" if v is None else f"{v:.4g}s"
 
@@ -405,6 +424,13 @@ def main(argv=None) -> int:
                         "window correlate into one fleet-level incident")
     p.add_argument("--json", action="store_true",
                    help="emit the analysis dict as JSON (one-shot mode)")
+    p.add_argument("--emit-signal", metavar="DIR", default="",
+                   help="on a dead-host finding, write a machine-readable "
+                        "signal file (obs/signals.py schema) into DIR — "
+                        "the directory an elastic supervisor "
+                        "(parallel/elastic.py) polls, so this monitor's "
+                        "detection drives the fleet's shrink-and-continue "
+                        "reaction; works in one-shot and --follow modes")
     args = p.parse_args(argv)
     kw = dict(stale_after_s=args.stale_after_s,
               skew_factor=args.skew_factor,
@@ -423,12 +449,22 @@ def main(argv=None) -> int:
                               f"in {args.run_dir} ...", flush=True)
                 else:
                     waiting = False
+                    if args.emit_signal and run["dead"]:
+                        for path in emit_dead_signals(run,
+                                                      args.emit_signal):
+                            print(f"[monitor] dead-host signal -> {path}",
+                                  flush=True)
                     print(format_status_line(run), flush=True)
                 time.sleep(args.interval_s)
         except (KeyboardInterrupt, BrokenPipeError):
             # ^C or a closed pipe (`... --follow | head`) ends the watch
             return 0
     run = analyze_dir(args.run_dir, **kw)
+    if args.emit_signal and run["dead"]:
+        for path in emit_dead_signals(run, args.emit_signal):
+            # stderr: --json consumers parse stdout as one JSON document
+            print(f"[monitor] dead-host signal -> {path}",
+                  file=sys.stderr, flush=True)
     if args.json:
         print(json.dumps(run))
     else:
