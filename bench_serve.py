@@ -115,10 +115,14 @@ def run_closed_loop(service, images, n_requests: int, n_clients: int) -> dict:
 
 
 def run_open_loop(service, images, n_requests: int, rate_rps: float,
-                  deadline_ms: float, seed: int = 0) -> dict:
+                  deadline_ms: float, seed: int = 0,
+                  on_arrival=None) -> dict:
     """Poisson arrivals at ``rate_rps``; every request carries a deadline.
     Tickets are collected afterwards — arrival timing never blocks on
-    results, so the service feels true open-loop pressure."""
+    results, so the service feels true open-loop pressure.
+    ``on_arrival(i)`` fires before request ``i`` is submitted — the
+    autoscale tier uses it to trigger a mid-run scale-up and measure p99
+    THROUGH the transition."""
     from can_tpu.serve import RejectedError
 
     rng = np.random.default_rng(seed)
@@ -130,6 +134,8 @@ def run_open_loop(service, images, n_requests: int, rate_rps: float,
         sleep = t0 + next_t - time.perf_counter()
         if sleep > 0:
             time.sleep(sleep)
+        if on_arrival is not None:
+            on_arrival(i)
         tickets.append(service.submit(images[i % len(images)],
                                       deadline_ms=deadline_ms))
     latencies, queue_waits, rejects = [], [], 0
@@ -150,6 +156,31 @@ def run_open_loop(service, images, n_requests: int, rate_rps: float,
             "wall_s": round(wall, 3),
             "queue_wait_p95_ms": _queue_wait_p95_ms(queue_waits),
             **_percentiles_ms(latencies)}
+
+
+def measure_time_to_first_ready(params, *, device, bucket_shapes,
+                                max_batch: int, serve_dtype: str = "f32",
+                                aot_bundle=None, telemetry=None,
+                                name: str = "ttfr") -> dict:
+    """Build + fully warm ONE replica engine on ``device`` — the
+    recovery-path latency the self-healing fleet pays for a resurrection
+    or scale-up.  Cold = live trace+compile per bucket; with an AOT
+    bundle = deserialized executables (zero new compiles, pinned via the
+    returned ``compiles``).  ``name`` must be unique per call: the
+    signature registry is per program name, and a reused name would hide
+    the cold path's compiles."""
+    from can_tpu.obs import Telemetry
+    from can_tpu.serve import ServeEngine
+
+    tel = telemetry if telemetry is not None else Telemetry()
+    t0 = time.perf_counter()
+    aot_tab = (aot_bundle.programs_for(device)
+               if aot_bundle is not None else None)
+    engine = ServeEngine(params, device=device, serve_dtype=serve_dtype,
+                         telemetry=tel, name=name, aot_programs=aot_tab)
+    rep = engine.warmup(bucket_shapes, max_batch)
+    return {"time_to_first_ready_s": round(time.perf_counter() - t0, 3),
+            "compiles": rep["compiles"], "aot_hits": engine.aot_hits}
 
 
 def main() -> None:

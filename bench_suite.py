@@ -972,6 +972,158 @@ def bench_serve_fleet(*, replicas=2, modes=("f32", "bf16", "int8"),
     return records
 
 
+def bench_autoscale(*, replicas=2, n_requests=32, repeats=3, max_batch=4,
+                    rate_rps=None, out_path=None) -> list:
+    """Self-healing/autoscale tier (ISSUE 13): time-to-first-ready for a
+    recovery-path replica, cold (live compiles) vs AOT-loaded
+    (deserialized executables), and open-loop p99 THROUGH a mid-run
+    scale-up event.
+
+    Records: ``serve_autoscale_ttfr_cold`` / ``serve_autoscale_ttfr_aot``
+    (unit ``s``: bench_compare gates duration UPWARD via its smaller-is-
+    better rule) and ``serve_autoscale_p99_scaleup`` (unit ``ms``, fixed
+    offered rate — the fleet tier's comparable-run discipline), each
+    median-of-``repeats`` with the measured spread recorded as the
+    gate's noise floor.  The AOT row also carries ``compiles`` (0 — the
+    zero-new-compiles receipt tests/test_autoscale.py pins)."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    from bench_serve import measure_time_to_first_ready, run_open_loop
+    from can_tpu.models import cannet_init
+    from can_tpu.obs import Telemetry
+    from can_tpu.serve import (
+        CountService,
+        FleetEngine,
+        load_aot_bundle,
+        prepare_image,
+    )
+
+    if rate_rps is None:
+        # below the 2-replica CPU box's saturation (the fleet tier's
+        # rule): p99 must measure latency, not end-of-run backlog
+        rate_rps = float(os.environ.get("BENCH_AUTOSCALE_RATE", "4"))
+    need = replicas + 1  # the scale-up's spare device
+    if len(jax.devices()) < need:
+        print(f"# autoscale tier skipped: {len(jax.devices())} device(s) "
+              f"< replicas+1={need} (use BENCH_SUITE_PLATFORM=cpu8 or a "
+              f"multi-chip host)", flush=True)
+        return []
+    params = cannet_init(jax.random.key(0))
+    sizes = [(64, 64), (96, 64)]
+    ladder = (tuple(sorted({h for h, _ in sizes})),
+              tuple(sorted({w for _, w in sizes})))
+    buckets = [(h, w) for h in ladder[0] for w in ladder[1]]
+    rng = np.random.default_rng(7)
+    images = [prepare_image(
+        (rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8))
+        for h, w in sizes]
+    tel = Telemetry()
+    fleet = FleetEngine(params, replicas=replicas, telemetry=tel,
+                        name="autoscale_fleet",
+                        devices=jax.devices()[:need])
+    svc = CountService(fleet, max_batch=max_batch, max_wait_ms=2.0,
+                       queue_capacity=256, bucket_ladder=ladder,
+                       telemetry=tel)
+    warm = svc.warmup(buckets)
+    with tempfile.TemporaryDirectory() as aot_dir:
+        manifest = fleet.bake_aot(aot_dir)
+        bundle = load_aot_bundle(aot_dir)
+        # time-to-first-ready on the SPARE device (exactly what a
+        # resurrection or scale-up pays), cold vs AOT, interleaved so
+        # host drift hits both arms equally (the host-tier discipline)
+        spare = jax.devices()[replicas]
+        cold_s, aot_s = [], []
+        aot_compiles = cold_compiles = 0
+        for rep in range(repeats):
+            c = measure_time_to_first_ready(
+                params, device=spare, bucket_shapes=buckets,
+                max_batch=max_batch, telemetry=tel,
+                name=f"ttfr_cold_{rep}")
+            a = measure_time_to_first_ready(
+                params, device=spare, bucket_shapes=buckets,
+                max_batch=max_batch, aot_bundle=bundle, telemetry=tel,
+                name=f"ttfr_aot_{rep}")
+            cold_s.append(c["time_to_first_ready_s"])
+            aot_s.append(a["time_to_first_ready_s"])
+            cold_compiles = max(cold_compiles, c["compiles"])
+            aot_compiles = max(aot_compiles, a["compiles"])
+
+        # p99 through a scale-up: fixed-rate open loop; at 1/3 of the
+        # arrivals the fleet grows onto the spare device from the bundle
+        fleet.load_aot(aot_dir)
+        p99s, rejects, scale_reports = [], 0, []
+        with svc:
+            for rep in range(repeats):
+                trigger_at = n_requests // 3
+                fired = []
+
+                def on_arrival(i, _fired=fired):
+                    if i == trigger_at and not _fired:
+                        _fired.append(True)
+                        scale_reports.append(
+                            fleet.add_replica(reason="bench_scaleup"))
+
+                o = run_open_loop(svc, images, n_requests, rate_rps,
+                                  deadline_ms=30_000, seed=rep,
+                                  on_arrival=on_arrival)
+                p99s.append(o["p99_ms"])
+                rejects += o["rejected"]
+                if fired:
+                    fleet.remove_replica(reason="bench_reset")
+        spread = lambda xs: round(  # noqa: E731
+            100.0 * (max(xs) - min(xs)) / max(statistics.median(xs), 1e-9),
+            1)
+        base = {"replicas": replicas, "offered_rps": rate_rps,
+                "requests": n_requests, "repeats": repeats,
+                "warmup_compiles": warm["compiles"],
+                "aot_programs": len(manifest["programs"]),
+                "aot_devices": len({p["device_id"]
+                                    for p in manifest["programs"]})}
+        records = [
+            {"metric": "serve_autoscale_ttfr_cold",
+             "value": round(statistics.median(cold_s), 3), "unit": "s",
+             "spread_pct": spread(cold_s), "compiles": cold_compiles,
+             **base},
+            {"metric": "serve_autoscale_ttfr_aot",
+             "value": round(statistics.median(aot_s), 3), "unit": "s",
+             "spread_pct": spread(aot_s), "compiles": aot_compiles,
+             **base},
+            {"metric": "serve_autoscale_p99_scaleup",
+             "value": round(statistics.median(p99s), 3), "unit": "ms",
+             "spread_pct": spread(p99s), "rejects": rejects,
+             "scale_ttfr_s": [r["time_to_first_ready_s"]
+                              for r in scale_reports],
+             "scale_compiles": [r["warmup_compiles"]
+                                for r in scale_reports], **base},
+        ]
+    for rec in records:
+        if _TELEMETRY is not None:
+            _TELEMETRY.emit("bench", **rec)
+        print(json.dumps(rec), flush=True)
+    out = out_path or os.environ.get("BENCH_AUTOSCALE_OUT")
+    if not out:
+        # committed gate baseline only for an explicit autoscale-only
+        # run (the perf/bn/fleet no-self-overwrite rule)
+        out = ("BENCH_AUTOSCALE_cpu_r13.json"
+               if os.environ.get("BENCH_SUITE_ONLY") == "autoscale"
+               else "BENCH_AUTOSCALE_local.json")
+    doc = {"metric": "serve_autoscale",
+           "config": {"replicas": replicas, "requests": n_requests,
+                      "repeats": repeats, "rate_rps": rate_rps,
+                      "max_batch": max_batch,
+                      "buckets": [f"{h}x{w}" for h, w in buckets],
+                      "platform": jax.devices()[0].platform},
+           "results": records}
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# autoscale tier: {len(records)} records -> {out}",
+          flush=True)
+    return records
+
+
 def bench_highres_eval(jnp, compute_dtype, *, h, w, steps, warmup=2):
     import jax
 
@@ -1071,6 +1223,8 @@ def main() -> None:
             bench_bn(jnp, jnp.bfloat16)
         if want("fleet"):
             bench_serve_fleet(n_requests=16, repeats=2)
+        if want("autoscale"):
+            bench_autoscale(n_requests=16, repeats=2)
     else:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=16, h=576, w=768, steps=20)
@@ -1116,6 +1270,10 @@ def main() -> None:
             # gate box (BENCH_FLEET_cpu_r11.json); chip-scale serving
             # numbers come from bench_serve.py open-loop sweeps
             bench_serve_fleet()
+        if want("autoscale"):
+            # same reproducible-on-the-gate-box rule
+            # (BENCH_AUTOSCALE_cpu_r13.json)
+            bench_autoscale()
 
     if _TELEMETRY is not None:
         from can_tpu.obs import emit_memory

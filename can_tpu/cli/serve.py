@@ -97,6 +97,41 @@ def parse_args(argv=None):
                    help="LEGACY bf16 compute with f32 params (counts "
                         "shift ~1e-3 relative); superseded by "
                         "--serve-dtype bf16, conflict if both given")
+    # self-healing fleet (ISSUE 13; all fleet-mode only)
+    p.add_argument("--aot-bundle", type=str, default="",
+                   help="load AOT-serialized predict executables from "
+                        "this bundle dir (serve/aot.py): warmup, "
+                        "resurrection, and scale-up DESERIALIZE instead "
+                        "of compiling — seconds to ready, zero new "
+                        "compiles; a stale bundle (params/dtype/jax "
+                        "mismatch) is refused with the axis named")
+    p.add_argument("--aot-bake", type=str, default="",
+                   help="after warmup, serialize the compiled predict "
+                        "grid for EVERY device into this bundle dir "
+                        "(written beside the checkpoint is the "
+                        "convention) and keep serving — the artifact "
+                        "--aot-bundle loads on the next start")
+    p.add_argument("--autoscale-max", type=int, default=0,
+                   help="enable the autoscaler with this replica "
+                        "ceiling (> --replicas; 0 = off): the fleet "
+                        "grows on sustained queue depth / p99-over-"
+                        "deadline / SLO burn and shrinks when idle, "
+                        "with hysteresis + cooldown — zero-drop "
+                        "transitions either way")
+    p.add_argument("--autoscale-min", type=int, default=None,
+                   help="autoscaler floor (default: --replicas)")
+    p.add_argument("--autoscale-interval-s", type=float, default=1.0,
+                   help="autoscaler evaluation period")
+    p.add_argument("--probe-cooldown-s", type=float, default=5.0,
+                   help="probation cooldown before a quarantined "
+                        "replica's first health probe (backoff doubles "
+                        "per failed probe, jittered)")
+    p.add_argument("--watchdog-slack", type=float, default=10.0,
+                   help="hang-watchdog deadline = cost-ledger expected "
+                        "execute time x this slack (per bucket)")
+    p.add_argument("--watchdog-default-s", type=float, default=30.0,
+                   help="hang-watchdog deadline before any timing "
+                        "exists (or without a ledger)")
     p.add_argument("--u8-warmup", action="store_true",
                    help="also pre-compile uint8-input programs, for "
                         "clients POSTing ?raw=1 (pixels stay bytes on the "
@@ -213,15 +248,43 @@ def build_service(args, telemetry=None):
                          "(drop --bf16)")
     if args.replicas < 1:
         raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    fleet_only = ["--aot-bundle", "--aot-bake", "--autoscale-max"]
+    if args.replicas <= 1 and (args.aot_bundle or args.aot_bake
+                               or args.autoscale_max):
+        raise SystemExit(f"{'/'.join(fleet_only)} need fleet mode "
+                         f"(--replicas >= 2)")
+    if args.autoscale_max and args.autoscale_max <= args.replicas:
+        raise SystemExit(f"--autoscale-max ({args.autoscale_max}) must "
+                         f"exceed --replicas ({args.replicas})")
+    if args.autoscale_max and args.autoscale_min is not None:
+        # validate BEFORE the checkpoint load: AutoscalePolicy would
+        # reject these anyway, but only after minutes of load+warmup
+        if not 1 <= args.autoscale_min <= args.autoscale_max:
+            raise SystemExit(
+                f"--autoscale-min ({args.autoscale_min}) must be in "
+                f"[1, --autoscale-max={args.autoscale_max}]")
     params, batch_stats = load_params(args)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     if args.replicas > 1:
+        from can_tpu.serve import AotStaleError
+
         run_config = _run_config_for(args.checkpoint_dir, args.torch_pth,
                                      args.params_npz)
-        engine = FleetEngine(params, batch_stats, replicas=args.replicas,
-                             serve_dtype=args.serve_dtype,
-                             compute_dtype=compute_dtype,
-                             telemetry=telemetry, run_config=run_config)
+        try:
+            engine = FleetEngine(
+                params, batch_stats, replicas=args.replicas,
+                serve_dtype=args.serve_dtype,
+                compute_dtype=compute_dtype,
+                telemetry=telemetry, run_config=run_config,
+                aot_bundle=args.aot_bundle or None,
+                probe_cooldown_s=args.probe_cooldown_s,
+                watchdog_slack=args.watchdog_slack,
+                watchdog_default_s=args.watchdog_default_s)
+        except AotStaleError as e:
+            # a stale bundle silently falling back to minutes of
+            # compiles defeats the flag's whole point: refuse, name the
+            # axis, point at the re-bake
+            raise SystemExit(f"--aot-bundle refused: {e}")
     else:
         engine = ServeEngine(params, batch_stats,
                              serve_dtype=args.serve_dtype,
@@ -246,11 +309,44 @@ def build_service(args, telemetry=None):
     # no live request ever pays a compile
     grid = [(h, w) for h in ladder[0] for w in ladder[1]]
     dtypes = (np.float32, np.uint8) if args.u8_warmup else (np.float32,)
-    report = service.warmup(grid, dtypes=dtypes)
+    try:
+        report = service.warmup(grid, dtypes=dtypes)
+    except Exception as e:
+        from can_tpu.serve import AotStaleError
+
+        if isinstance(e, AotStaleError):
+            # warmup re-checks the batch-geometry axes (max_batch,
+            # bucket grid) the constructor can't know yet — same clean
+            # refusal as a construction-time mismatch
+            raise SystemExit(f"--aot-bundle refused: {e}")
+        raise
     reps = f" x {args.replicas} replicas" if args.replicas > 1 else ""
+    aot = " [AOT]" if args.replicas > 1 and args.aot_bundle else ""
     print(f"[serve] warmup: {report['compiles']} programs over "
           f"{report['shapes']} bucket shapes{reps} "
-          f"[{args.serve_dtype}] in {report['seconds']:.1f}s")
+          f"[{args.serve_dtype}]{aot} in {report['seconds']:.1f}s")
+    if args.replicas > 1 and args.aot_bake:
+        manifest = engine.bake_aot(args.aot_bake)
+        engine.load_aot(args.aot_bake)  # this run heals from it too
+        print(f"[serve] AOT bundle: {len(manifest['programs'])} programs "
+              f"over {len(engine._devices_all)} devices -> "
+              f"{args.aot_bake} ({manifest['bake_seconds']:.1f}s)")
+    if args.replicas > 1 and args.autoscale_max:
+        from can_tpu.serve import Autoscaler, AutoscalePolicy
+
+        policy = AutoscalePolicy(
+            min_replicas=(args.autoscale_min
+                          if args.autoscale_min is not None
+                          else args.replicas),
+            max_replicas=args.autoscale_max,
+            p99_high_s=(args.deadline_ms / 1e3
+                        if args.deadline_ms else None),
+            interval_s=args.autoscale_interval_s)
+        gauges = getattr(telemetry, "_gauge_sink", None)
+        service.autoscaler = Autoscaler(service, policy, gauges=gauges)
+        print(f"[serve] autoscaler armed: {policy.min_replicas}.."
+              f"{policy.max_replicas} replicas, "
+              f"eval every {policy.interval_s:g}s")
     return service
 
 

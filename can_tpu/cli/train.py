@@ -391,6 +391,9 @@ def build_telemetry(args, *, host_id: int, trace_window, logger=None,
                                       extra_sinks=extra, trace=trace)
     else:
         tel = obs.Telemetry(extra, host_id=host_id, trace=trace)
+    # the gauge sink rides the bus handle (like .ledger/.spans): the
+    # serve CLI's autoscaler reads can_tpu_slo_alerting from it
+    tel._gauge_sink = gauges
     # performance-attribution collaborators ride the same arming rule as
     # the loop instrumentation: any consumer (JSONL artifact, live
     # /metrics scraper, trace window, incident recorder, SLO engine)

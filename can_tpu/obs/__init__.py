@@ -37,7 +37,7 @@ from .health import (
     ThroughputDetector,
 )
 from .incidents import IncidentManager, install_sigterm_handler
-from .lifecycle import shutdown_telemetry
+from .lifecycle import shutdown_telemetry, supervised_loop
 from .report import format_report, read_events, read_events_counted, summarize
 from .sources import (
     Heartbeat,
@@ -85,5 +85,6 @@ __all__ = [
     "read_events_counted",
     "render_stats",
     "shutdown_telemetry",
+    "supervised_loop",
     "summarize",
 ]

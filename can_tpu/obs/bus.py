@@ -60,8 +60,15 @@ import numpy as np
 # tree — add the kind HERE when adding an emitter, or that test fails.
 # fleet.* kinds come from the serving fleet (can_tpu/serve/fleet.py):
 # fleet.replica is a replica state transition (quarantine on failure,
-# generation bump on rollout flip) and fleet.rollout is one completed
-# blue/green checkpoint rollout report.
+# wedge on a watchdog deadline, drain on scale-down, generation bump on
+# rollout flip) and fleet.rollout is one completed blue/green checkpoint
+# rollout report.  The self-healing layer adds fleet.probe (one
+# probation health probe, ok or failed with the escalated backoff),
+# fleet.resurrect (a quarantined/wedged replica re-staged at the current
+# generation and back in dispatch — can_tpu_fleet_resurrections_total),
+# and fleet.scale (one add/remove replica transition, with
+# time_to_first_ready_s on the up direction —
+# can_tpu_fleet_scale_events_total).
 # incident.bundle and slo.burn come from the incident layer:
 # incident.bundle records one written incident bundle (obs/incidents.py
 # — reason/severity/path/suppressed counts; GaugeSink counts them as
@@ -77,6 +84,7 @@ EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
                "fleet.replica", "fleet.rollout",
+               "fleet.probe", "fleet.resurrect", "fleet.scale",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",
                "perf.summary", "trace.span",

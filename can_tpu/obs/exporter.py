@@ -189,10 +189,30 @@ class GaugeSink:
                         float(p["generation"])
             elif kind == "fleet.replica":
                 # state transitions: count quarantines per replica (flip
-                # events re-announce "active" and are not failures)
-                if str(p.get("state")) == "quarantined":
+                # events re-announce "active" and are not failures); a
+                # watchdog wedge is the hang flavour of the same loss
+                if str(p.get("state")) in ("quarantined", "wedged"):
                     self._count((f"{pre}_fleet_quarantines_total",
                                  (("replica", str(p.get("replica", "?"))),)))
+            elif kind == "fleet.scale":
+                # one autoscale/manual add/remove transition; the live
+                # count gauge rides the event (sampled exactly when it
+                # changes, the serve.batch queue-depth discipline)
+                self._count((f"{pre}_fleet_scale_events_total",
+                             (("direction",
+                               str(p.get("direction", "?"))),)))
+                if p.get("live") is not None:
+                    self._gauges[f"{pre}_fleet_live_replicas"] = \
+                        float(p["live"])
+            elif kind == "fleet.resurrect":
+                self._count((f"{pre}_fleet_resurrections_total",
+                             (("replica", str(p.get("replica", "?"))),)))
+                if p.get("live") is not None:
+                    self._gauges[f"{pre}_fleet_live_replicas"] = \
+                        float(p["live"])
+            elif kind == "fleet.probe":
+                self._count((f"{pre}_fleet_probes_total",
+                             (("ok", "1" if p.get("ok") else "0"),)))
             elif kind == "slo.burn":
                 # one objective's multi-window burn evaluation
                 # (obs/slo.py): per-window burns and the alerting state

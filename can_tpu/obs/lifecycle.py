@@ -41,3 +41,22 @@ def shutdown_telemetry(telemetry, *, heartbeat=None, exporter=None) -> None:
         except Exception as e:  # noqa: BLE001 — teardown must finish
             print(f"[telemetry] teardown step failed "
                   f"({type(e).__name__}: {e}); continuing", flush=True)
+
+
+def supervised_loop(stop, interval_s: float, tick, label: str) -> None:
+    """The daemon-supervisor loop body shared by the fleet maintenance
+    thread and the autoscaler: ``tick()`` every ``interval_s`` until
+    ``stop`` (a ``threading.Event``) is set, surviving any single sick
+    tick under the sink contract — warn once per FAILURE STREAK (a
+    recovery re-arms the warning), never kill the loop."""
+    warned = False
+    while not stop.wait(interval_s):
+        try:
+            tick()
+            warned = False
+        except Exception as e:  # noqa: BLE001 — the supervisor must
+            # outlive any single sick tick
+            if not warned:
+                warned = True
+                print(f"[{label}] tick failed ({type(e).__name__}: {e});"
+                      f" kept — will retry next interval", flush=True)

@@ -78,6 +78,11 @@ def summarize(events: Iterable[dict]) -> dict:
     fleet_generation = None
     fleet_quarantines: dict = {}
     fleet_states: dict = {}
+    fleet_scale = {"up": 0, "down": 0}
+    fleet_live_last = None
+    fleet_resurrections = 0
+    fleet_probes = {"ok": 0, "failed": 0}
+    fleet_ttfr_last = None
     cache_last: Optional[dict] = None
     planner_last: Optional[dict] = None
     prepared_splits: dict = {}
@@ -166,8 +171,21 @@ def summarize(events: Iterable[dict]) -> dict:
         elif kind == "fleet.replica":
             rk = str(p.get("replica", "?"))
             fleet_states[rk] = str(p.get("state", "?"))  # last state wins
-            if p.get("state") == "quarantined":
+            if p.get("state") in ("quarantined", "wedged"):
                 fleet_quarantines[rk] = fleet_quarantines.get(rk, 0) + 1
+        elif kind == "fleet.scale":
+            d = str(p.get("direction", "?"))
+            fleet_scale[d] = fleet_scale.get(d, 0) + 1
+            if p.get("live") is not None:
+                fleet_live_last = int(p["live"])
+            if p.get("time_to_first_ready_s") is not None:
+                fleet_ttfr_last = float(p["time_to_first_ready_s"])
+        elif kind == "fleet.resurrect":
+            fleet_resurrections += 1
+            if p.get("live") is not None:
+                fleet_live_last = int(p["live"])
+        elif kind == "fleet.probe":
+            fleet_probes["ok" if p.get("ok") else "failed"] += 1
         elif kind == "incident.bundle":
             reason = str(p.get("reason", "?"))
             incidents_by_reason[reason] = \
@@ -224,6 +242,15 @@ def summarize(events: Iterable[dict]) -> dict:
         "fleet_generation": fleet_generation,
         "fleet_quarantines": sum(fleet_quarantines.values()),
         "fleet_replica_states": dict(sorted(fleet_states.items())),
+        # self-healing layer (ISSUE 13): scale transitions, probation
+        # probes, resurrections, last time-to-first-ready
+        "fleet_scale_up": fleet_scale.get("up", 0),
+        "fleet_scale_down": fleet_scale.get("down", 0),
+        "fleet_live_replicas": fleet_live_last,
+        "fleet_resurrections": fleet_resurrections,
+        "fleet_probes_ok": fleet_probes["ok"],
+        "fleet_probes_failed": fleet_probes["failed"],
+        "fleet_ttfr_last_s": fleet_ttfr_last,
         # host data pipeline (can_tpu/data/prepared.py); Nones/empty offline
         "prepared_splits": dict(sorted(prepared_splits.items())),
         "cache_hits": cache_last.get("hits") if cache_last else None,
@@ -452,6 +479,21 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
              + ((" replicas: "
                  + " ".join(f"r{k}={v}" for k, v in states.items()))
                 if states else "")))
+    if (summary.get("fleet_resurrections") or summary.get("fleet_scale_up")
+            or summary.get("fleet_scale_down")
+            or summary.get("fleet_probes_ok")
+            or summary.get("fleet_probes_failed")):
+        rows.append(
+            ("fleet healing",
+             f"resurrections={summary['fleet_resurrections']} "
+             f"probes ok={summary['fleet_probes_ok']}/"
+             f"failed={summary['fleet_probes_failed']} "
+             f"scale up={summary['fleet_scale_up']}/"
+             f"down={summary['fleet_scale_down']}"
+             + (f" live={summary['fleet_live_replicas']}"
+                if summary.get("fleet_live_replicas") is not None else "")
+             + (f" ttfr={_fmt(summary['fleet_ttfr_last_s'])} s"
+                if summary.get("fleet_ttfr_last_s") is not None else "")))
     width = max(len(k) for k, _ in rows)
     lines = [f"# {title}"]
     lines += [f"{k.ljust(width)}  {v}" for k, v in rows]

@@ -23,13 +23,19 @@ all paid in ``warmup``; a served count is bit-for-bit what ``evaluate()``
 computes offline for the same image and params.
 """
 
+from .aot import AotBundle, AotStaleError, load_aot_bundle
+from .autoscale import Autoscaler, AutoscalePolicy
 from .batcher import MicroBatcher
 from .engine import ServeEngine, tree_signature
 from .fleet import (
     REPLICA_ACTIVE,
+    REPLICA_DRAINING,
     REPLICA_QUARANTINED,
+    REPLICA_WEDGED,
     FleetClosedError,
     FleetEngine,
+    ReplicaWedgedError,
+    priced_deadline_s,
 )
 from .quant import (
     PARITY_LADDER,
@@ -58,6 +64,10 @@ from .service import (
 )
 
 __all__ = [
+    "AotBundle",
+    "AotStaleError",
+    "Autoscaler",
+    "AutoscalePolicy",
     "BoundedRequestQueue",
     "CountService",
     "FleetClosedError",
@@ -65,7 +75,12 @@ __all__ = [
     "MicroBatcher",
     "PARITY_LADDER",
     "REPLICA_ACTIVE",
+    "REPLICA_DRAINING",
     "REPLICA_QUARANTINED",
+    "REPLICA_WEDGED",
+    "ReplicaWedgedError",
+    "load_aot_bundle",
+    "priced_deadline_s",
     "SERVE_DTYPES",
     "dequantize_tree",
     "parity_report",

@@ -86,11 +86,18 @@ class ServeEngine:
     def __init__(self, params, batch_stats=None, *, compute_dtype=None,
                  serve_dtype: str = "f32", ds: int = 8, device=None,
                  quantized: bool = False, telemetry=None,
-                 name: str = "serve_predict"):
+                 name: str = "serve_predict", aot_programs=None):
         self.ds = int(ds)
         self.serve_dtype = serve_dtype
         self.device = device
         self.name = name
+        # AOT warm start (serve/aot.py): {(image shape, dtype str):
+        # loaded Compiled}.  A matching batch executes the DESERIALIZED
+        # binary — no trace, no compile, compile_count untouched; misses
+        # fall through to the jit path and are counted like any compile.
+        self._aot = dict(aot_programs) if aot_programs else {}
+        self.aot_hits = 0
+        self.released = False
         if not quantized:
             params = quantize_tree(params, serve_dtype)
         self.params = self._put(params)
@@ -124,6 +131,7 @@ class ServeEngine:
         self._predict = RecompileTracker(jax.jit(predict), self.telemetry,
                                          name=name, batch_arg=1)
         self._signatures = self.telemetry.signature_registry[name]
+        self._last_compiled = False
 
     def _put(self, tree):
         if self.device is None:
@@ -168,20 +176,111 @@ class ServeEngine:
         shipped device→host when a request actually asked for it.  The
         compiled program is identical either way: only the host fetch is
         conditional, so the jit signature (and the warmup compile budget)
-        doesn't fork on ``want_density``."""
-        counts, density = self._predict(self.params, _batch_dict(batch),
-                                        self.batch_stats)
+        doesn't fork on ``want_density``.
+
+        With an AOT table (``aot_programs``), a batch whose exact
+        (shape, dtype) was baked executes the loaded binary: no trace, no
+        compile, ``last_batch_compiled`` False.  Misses fall through to
+        the jit path unchanged."""
+        if self.released:
+            raise RuntimeError(f"engine {self.name}: buffers released "
+                               f"(quarantined/retired replica) — build a "
+                               f"fresh engine to serve again")
+        prog = (self._aot.get((tuple(batch.image.shape),
+                               str(batch.image.dtype)))
+                if self._aot else None)
+        if prog is not None:
+            counts, density = prog(self.params, _batch_dict(batch),
+                                   self.batch_stats)
+            self.aot_hits += 1
+            self._last_compiled = False
+        else:
+            counts, density = self._predict(self.params,
+                                            _batch_dict(batch),
+                                            self.batch_stats)
+            self._last_compiled = self._predict.last_first_call
         # can-tpu-lint: disable=HOSTSYNC(the fetch IS the product: callers resolve waiting requests with it)
         return (np.asarray(counts),
                 # can-tpu-lint: disable=HOSTSYNC(fetched only when a request asked for the density tensor)
                 np.asarray(density) if want_density else None)
 
+    def is_warm(self, batch: Batch) -> bool:
+        """True when dispatching ``batch`` runs an already-built program
+        — an AOT table hit or a jit signature this engine has seen.
+        False means the dispatch would pay a live trace+lower+compile
+        (the fleet's watchdog prices those launches with the compile
+        allowance instead of the steady-state deadline)."""
+        if (self._aot and (tuple(batch.image.shape),
+                           str(batch.image.dtype)) in self._aot):
+            return True
+        from can_tpu.train.steps import batch_signature
+
+        return batch_signature(_batch_dict(batch)) in self._signatures
+
     @property
     def last_batch_compiled(self) -> bool:
         """True when the most recent ``predict_batch`` hit a new signature
         (its wall time is compile, not steady-state — keep it out of
-        latency reservoirs, exactly like the offline loops do)."""
-        return self._predict.last_first_call
+        latency reservoirs, exactly like the offline loops do).  AOT hits
+        are never compiles."""
+        return self._last_compiled
+
+    def release_buffers(self) -> None:
+        """Drop every reference to the device-resident param/batch-stats
+        trees (and the loaded AOT executables) so the device's bytes are
+        freed by refcount.  Deliberately NOT ``x.delete()``: the fleet's
+        batched replication can alias per-device shards across replica
+        trees, and a force-delete would invalidate a sibling replica's
+        params — refcount release frees exactly this replica's bytes once
+        nothing else holds them.  Idempotent; a released engine refuses
+        ``predict_batch`` with a typed error instead of tracing None
+        params into jit."""
+        self.params = None
+        self.batch_stats = None
+        self._aot = {}
+        self.released = True
+        import gc
+
+        gc.collect()  # quarantine path, rare: make the free deterministic
+
+    # -- AOT export (serve/aot.py bake path) ------------------------------
+    def compile_program(self, batch: Batch):
+        """Lower+compile the exact predict program this engine would
+        dispatch for ``batch`` (the cost-ledger precedent: a second
+        compile on an already-slow path, persistent-cache-deduped)."""
+        from can_tpu.obs.costs import resolve_jit
+
+        args = (self.params, _batch_dict(batch), self.batch_stats)
+        return resolve_jit(self._predict, args).lower(*args).compile()
+
+    def serialize_program(self, batch: Batch) -> Tuple[bytes, dict]:
+        """One bucket program as a self-contained payload: the serialized
+        executable plus its pickled arg/result treedefs (device-free —
+        devices ride the executable itself, keyed by id at load).  Returns
+        ``(payload, meta)`` with the program's cost facts in ``meta`` when
+        the backend reports them (the bundle's contract receipt)."""
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        compiled = self.compile_program(batch)
+        ser, in_tree, out_tree = se.serialize(compiled)
+        meta = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if ca:
+                if ca.get("flops"):
+                    # can-tpu-lint: disable=HOSTSYNC(bake path, host floats from cost_analysis — no device value involved)
+                    meta["flops"] = float(ca["flops"])
+                if ca.get("bytes accessed"):
+                    # can-tpu-lint: disable=HOSTSYNC(bake path, host floats from cost_analysis — no device value involved)
+                    meta["bytes_accessed"] = float(ca["bytes accessed"])
+        # can-tpu-lint: disable=SWALLOW(cost facts are receipts, not requirements; a non-reporting backend still bakes)
+        except Exception:
+            pass
+        return pickle.dumps((ser, in_tree, out_tree)), meta
 
     def warmup(self, bucket_shapes, max_batch: int, *,
                dtypes=(np.float32,)) -> dict:

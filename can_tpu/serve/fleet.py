@@ -39,10 +39,33 @@ replica dies.  This module is that fleet layer:
   same-signature tree swap reuses every compiled program with zero
   recompilation, and at most one replica is briefly paused while the
   others keep pulling work.
+
+* **Self healing** (ISSUE 13) — containment alone shrinks the fleet
+  monotonically; this layer grows it back.  A quarantined replica
+  RELEASES its device-resident params immediately (a dead replica costs
+  zero HBM) and enters probation: after a backoff-with-jitter cooldown
+  the maintenance thread re-stages params from the fleet's host-side
+  copy of the CURRENT generation (quarantined replicas are skipped by
+  rollout, so a naive re-admit would serve stale weights), probes one
+  warm-bucket predict off-path, and on success the replica rejoins
+  dispatch at the current generation (``fleet.probe`` /
+  ``fleet.resurrect`` events).  Repeated probe failures escalate the
+  backoff and page once per cooldown via the incident layer.  A HANG is
+  caught by the watchdog: every launch carries a deadline priced from
+  the cost ledger's measured per-program time x slack (a fixed default
+  when no timing exists yet); an overdue replica is marked ``wedged``,
+  its in-flight batch re-dispatched under the existing redispatch-once
+  rule, and the replica sent to the same probation path — the stuck
+  worker thread is abandoned, never waited on.  ``add_replica`` /
+  ``remove_replica`` grow and drain the fleet with the same zero-drop
+  choreography (``serve/autoscale.py`` drives them from the gauges), and
+  an AOT bundle (``serve/aot.py``) makes every one of these paths load
+  executables instead of compiling: seconds to ready, zero new compiles.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import deque
@@ -53,15 +76,53 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from can_tpu.obs import Telemetry
+from can_tpu.serve.aot import bake_aot_bundle, load_aot_bundle, signature_sha
 from can_tpu.serve.engine import ServeEngine, tree_signature
-from can_tpu.serve.quant import quantize_tree
+from can_tpu.serve.quant import host_tree, quantize_tree
+from can_tpu.testing.faults import active_injector
 
 REPLICA_ACTIVE = "active"
 REPLICA_QUARANTINED = "quarantined"
+REPLICA_WEDGED = "wedged"        # watchdog-declared hung launch
+REPLICA_DRAINING = "draining"    # scale-down: finish in-flight, exit
 
 
 class FleetClosedError(RuntimeError):
     """Work submitted after the fleet shut down."""
+
+
+class ReplicaWedgedError(RuntimeError):
+    """A launch blew through its priced watchdog deadline."""
+
+
+def priced_deadline_s(ledger, name_prefix: str, shape, *,
+                      slack: float, floor_s: float,
+                      default_s: float, dtype=None) -> float:
+    """Watchdog deadline for one launch: the cost ledger's measured
+    mean execute time for this exact image (shape, dtype) — max over
+    this fleet's replica programs, timing-reliable rows only — x
+    ``slack``, floored at ``floor_s``.  Falls back to ``default_s``
+    when no ledger is armed or no reliable timing exists yet (first
+    batches after warmup, or a backend whose cost analysis never
+    reported) — a fixed bound beats an unbounded hang, and the priced
+    bound takes over as launches accumulate.  ``dtype`` matters: a u8
+    batch is a DIFFERENT program than the same-shape f32 one, and
+    pricing it off the f32 rows would set a deadline the u8 program
+    never agreed to (rows with unknown dtype still match)."""
+    if ledger is None:
+        return default_s
+    try:
+        rows = [r for r in ledger.rows()
+                if r["name"].startswith(name_prefix)
+                and tuple(r["shape"]) == tuple(shape)
+                and (dtype is None or r.get("dtype") in (dtype, "?"))
+                and r["timing_reliable"] and r["mean_s"]]
+    # can-tpu-lint: disable=SWALLOW(pricing must never kill dispatch; the fixed default is the degrade)
+    except Exception:
+        return default_s
+    if not rows:
+        return default_s
+    return max(max(r["mean_s"] for r in rows) * slack, floor_s)
 
 
 class _WorkItem:
@@ -75,7 +136,15 @@ class _WorkItem:
 
 
 class ReplicaState:
-    """One replica: engine + device + dispatch lock + health."""
+    """One replica: engine + device + dispatch lock + health.
+
+    ``inflight`` is ``(item, t_start, deadline_s)`` while the worker is
+    inside a device execute (guarded by the fleet's ``_cond``): the
+    watchdog's whole view of a possibly-hung launch.  ``probe_at`` /
+    ``probe_failures`` / ``backoff_s`` drive probation after quarantine.
+    Resurrection REPLACES the ReplicaState (same index, fresh engine +
+    worker thread) rather than reviving it, so an abandoned worker
+    holding the old object can never serve alongside the new one."""
 
     def __init__(self, index: int, device, engine: ServeEngine):
         self.index = index
@@ -89,12 +158,24 @@ class ReplicaState:
         self.failures = 0
         self.error: Optional[str] = None
         self.generation = 0
+        self.inflight: Optional[Tuple] = None  # guarded by fleet._cond
+        self.probe_at: Optional[float] = None
+        self.probe_failures = 0
+        self.backoff_s: Optional[float] = None
+        self.thread: Optional[threading.Thread] = None
+        # probation bookkeeping (guarded by fleet._cond): ``probing`` is
+        # the start ts of an in-flight probe thread, ``probe_token``
+        # invalidates a timed-out/superseded probe so its late result
+        # can never swap in
+        self.probing: Optional[float] = None
+        self.probe_token = 0
 
     def snapshot(self) -> dict:
         return {"replica": self.index, "device": str(self.device),
                 "state": self.state, "batches": self.batches,
                 "failures": self.failures, "error": self.error,
-                "generation": self.generation}
+                "generation": self.generation,
+                "probe_failures": self.probe_failures}
 
 
 def _replicate(tree, devices):
@@ -128,7 +209,17 @@ class FleetEngine:
                  serve_dtype: str = "f32", compute_dtype=None, ds: int = 8,
                  devices: Optional[Sequence] = None, telemetry=None,
                  run_config: Optional[dict] = None,
-                 name: str = "serve_predict"):
+                 name: str = "serve_predict", aot_bundle=None,
+                 self_heal: bool = True,
+                 maintain_interval_s: float = 0.25,
+                 probe_cooldown_s: float = 5.0,
+                 probe_backoff_factor: float = 2.0,
+                 probe_backoff_max_s: float = 120.0,
+                 probe_jitter: float = 0.1,
+                 page_after_probes: int = 3,
+                 watchdog_slack: float = 10.0,
+                 watchdog_floor_s: float = 1.0,
+                 watchdog_default_s: float = 30.0):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         devices = list(devices if devices is not None else jax.devices())
@@ -144,9 +235,51 @@ class FleetEngine:
         self.run_config = run_config
         self.name = name
         self.generation = 0
+        # the scale universe: every device a replica may ever land on —
+        # self.devices (below) is just the INITIAL placement
+        self._devices_all = devices
         self.devices = devices[:replicas]
+        # self-healing knobs (see DESIGN §18)
+        self.self_heal = bool(self_heal)
+        self.maintain_interval_s = float(maintain_interval_s)
+        self.probe_cooldown_s = float(probe_cooldown_s)
+        self.probe_backoff_factor = float(probe_backoff_factor)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.probe_jitter = float(probe_jitter)
+        self.page_after_probes = int(page_after_probes)
+        self.watchdog_slack = float(watchdog_slack)
+        self.watchdog_floor_s = float(watchdog_floor_s)
+        self.watchdog_default_s = float(watchdog_default_s)
+        # probes run on their OWN daemon threads (a probe predict on a
+        # still-sick device can hang exactly like the launch that
+        # wedged it — it must never hold the maintenance thread or
+        # _rollout_lock hostage); this bounds how long a probe may run
+        # before it is declared failed and its thread abandoned
+        self.probe_timeout_s = 600.0
+        # deadline for a launch the engine has NOT built yet (no AOT
+        # hit, unseen jit signature): a legitimate live trace+compile
+        # is minutes on a real chip, and pricing it like a steady-state
+        # launch would wedge a healthy replica on e.g. the first
+        # unwarmed raw-u8 request — and cascade-quarantine the fleet
+        self.watchdog_compile_s = 900.0
+        # jitter is seeded per fleet: chaos tests reproduce bit-exactly
+        self._rng = random.Random(0xC0FFEE)
 
         qparams = quantize_tree(params, serve_dtype)
+        # the CURRENT generation's quantized tree, HOST-side: what
+        # resurrection and scale-up stage from.  Host RAM (~21-83 MB per
+        # mode), not a replicated device tree — a dead replica must cost
+        # zero HBM, not "zero plus a pinned param copy".
+        self._host_q = (host_tree(qparams),
+                        None if batch_stats is None
+                        else host_tree(batch_stats))
+        self._sig_sha = signature_sha(*self._host_q)
+        if isinstance(aot_bundle, str):
+            aot_bundle = load_aot_bundle(aot_bundle)
+        if aot_bundle is not None:
+            aot_bundle.check(sig_sha=self._sig_sha,
+                             serve_dtype=serve_dtype, ds=self.ds)
+        self._aot = aot_bundle
         rep_params = _replicate(qparams, self.devices)
         rep_stats = (None if batch_stats is None
                      else _replicate(batch_stats, self.devices))
@@ -157,8 +290,16 @@ class FleetEngine:
                 None if rep_stats is None else _per_device(rep_stats, dev),
                 serve_dtype=serve_dtype, compute_dtype=compute_dtype,
                 ds=ds, device=dev, quantized=True, telemetry=self.telemetry,
-                name=f"{name}_r{k}")
+                name=f"{name}_r{k}",
+                aot_programs=(self._aot.programs_for(dev)
+                              if self._aot is not None else None))
             self.replicas.append(ReplicaState(k, dev, engine))
+        # per-slot incarnation counters: a resurrected replica's engine
+        # gets a DISTINCT program name (f"{name}_r{k}i{n}"), so its
+        # compile_count starts at zero and any live compile on the
+        # recovery path is visible instead of hidden by the old registry
+        self._incarnations = {k: 1 for k in range(replicas)}
+        self._next_index = replicas
 
         self._queue: deque = deque()
         self._cond = threading.Condition()
@@ -168,6 +309,14 @@ class FleetEngine:
         self._threads: List[threading.Thread] = []
         self._rollout_lock = threading.Lock()
         self._warmup_spec: Optional[Tuple] = None
+        self._maint_thread: Optional[threading.Thread] = None
+        self._maint_stop = threading.Event()
+        self._probe_threads: List[threading.Thread] = []
+        # serialises scale transitions against EACH OTHER only — device
+        # work (a new replica's warmup, a drain join) must never hold
+        # _rollout_lock, or a sick spare device would freeze probes,
+        # rollout, and the rest of the healing layer with it
+        self._scale_lock = threading.Lock()
         # bound by CountService: completion/failure sinks for executed work
         self._on_complete: Optional[Callable] = None
         self._on_fail: Optional[Callable] = None
@@ -213,6 +362,13 @@ class FleetEngine:
         # can-tpu-lint: disable=LOCKHELD(warmup precedes traffic; rollout reads this under _rollout_lock afterwards)
         self._warmup_spec = (sorted(set(map(tuple, bucket_shapes))),
                              int(max_batch), tuple(dtypes))
+        if self._aot is not None:
+            # the bundle must cover THIS grid at THIS batch geometry —
+            # a silent partial hit would hide live compiles behind "AOT"
+            self._aot.check(sig_sha=self._sig_sha,
+                            serve_dtype=self.serve_dtype, ds=self.ds,
+                            max_batch=max_batch,
+                            bucket_shapes=self._warmup_spec[0])
         t0 = time.perf_counter()
         shapes = compiles = 0
         for r in self.replicas:
@@ -226,16 +382,28 @@ class FleetEngine:
                 "seconds": round(time.perf_counter() - t0, 3)}
 
     # -- lifecycle --------------------------------------------------------
+    def _spawn_worker(self, replica: ReplicaState) -> None:
+        t = threading.Thread(target=self._worker, args=(replica,),
+                             daemon=True,
+                             name=f"can-tpu-fleet-r{replica.index}")
+        replica.thread = t
+        with self._cond:
+            self._threads.append(t)
+        t.start()
+
     def start(self) -> "FleetEngine":
         if self._started:
             return self
         # can-tpu-lint: disable=LOCKHELD(idempotent lifecycle flag; start runs on the owner thread)
         self._started = True
         for r in self.replicas:
-            t = threading.Thread(target=self._worker, args=(r,),
-                                 daemon=True,
-                                 name=f"can-tpu-fleet-r{r.index}")
-            self._threads.append(t)
+            self._spawn_worker(r)
+        if self.self_heal and self._maint_thread is None:
+            self._maint_stop.clear()
+            t = threading.Thread(target=self._maintain_loop, daemon=True,
+                                 name="can-tpu-fleet-maint")
+            # can-tpu-lint: disable=LOCKHELD(start runs once on the owner thread before any maintenance exists)
+            self._maint_thread = t
             t.start()
         return self
 
@@ -243,6 +411,13 @@ class FleetEngine:
         """Drain queued work through the replicas, then stop the threads.
         Anything still queued when no live replica remains (or the drain
         times out) is failed, never silently dropped."""
+        # maintenance first: a probe mid-close would race the drain
+        self._maint_stop.set()
+        mt = self._maint_thread
+        if mt is not None:
+            mt.join(timeout=10.0)
+            # can-tpu-lint: disable=LOCKHELD(close is idempotent-guarded below and runs on the owner thread)
+            self._maint_thread = None
         with self._cond:
             if self._closed:
                 return
@@ -314,22 +489,76 @@ class FleetEngine:
                     if self._on_reject is not None:
                         self._on_reject(REJECT_DEADLINE, n)
                 continue
+            # register the launch for the watchdog BEFORE entering the
+            # execute: (item, start, priced deadline) under _cond is the
+            # watchdog's whole view of this replica
+            with self._cond:
+                replica.inflight = (item, self._clock(),
+                                    self._deadline_for(item, replica))
             t0 = time.perf_counter()
             try:
                 with replica.lock:
+                    inj = active_injector()
+                    if inj is not None:
+                        # serve chaos hooks (testing/faults.py):
+                        # replica_crash raises into the quarantine path,
+                        # replica_hang sleeps into the watchdog's arms —
+                        # both exactly as a real device fault would
+                        inj.on_serve_batch(replica=replica.index,
+                                           batch_index=replica.batches + 1)
                     want = any(r.want_density for r in item.requests)
                     counts, density = replica.engine.predict_batch(
                         item.batch, want_density=want)
                     compiled = replica.engine.last_batch_compiled
                     replica.batches += 1
             except Exception as e:  # noqa: BLE001 — replica failure path
-                self._quarantine(replica, item, e)
+                if self._finish_inflight(replica, item):
+                    self._quarantine(replica, item, e)
+                # else: the watchdog already wedged us and re-dispatched
+                # the batch — nothing left to attribute
                 continue
             execute_s = time.perf_counter() - t0
+            if not self._finish_inflight(replica, item):
+                # wedged mid-execute: the watchdog stole the batch (it
+                # may already be resolved on a healthy replica) — discard
+                # our late results; the next _take sees the wedged state
+                # and retires this thread
+                continue
             if self._on_complete is not None:
                 self._on_complete(item.bucket_hw, item.batch, item.requests,
                                   counts, density, execute_s, compiled,
                                   replica.index, replica.engine.name)
+
+    def _finish_inflight(self, replica: ReplicaState, item: _WorkItem
+                         ) -> bool:
+        """Clear the replica's in-flight slot iff it still owns ``item``;
+        False means the watchdog stole it (exactly one of the worker and
+        the watchdog wins — both mutate under ``_cond``)."""
+        with self._cond:
+            mine = (replica.inflight is not None
+                    and replica.inflight[0] is item)
+            if mine:
+                replica.inflight = None
+            return mine
+
+    def _deadline_for(self, item: _WorkItem,
+                      replica: ReplicaState) -> float:
+        try:
+            warm = replica.engine.is_warm(item.batch)
+        # can-tpu-lint: disable=SWALLOW(pricing must never kill dispatch; assume warm = the tighter bound)
+        except Exception:
+            warm = True
+        if not warm:
+            # a legitimate first-compile launch: give it the compile
+            # allowance, not the steady-state deadline
+            return max(self.watchdog_compile_s, self.watchdog_default_s)
+        ledger = getattr(self.telemetry, "ledger", None)
+        return priced_deadline_s(ledger, self.name,
+                                 item.batch.image.shape,
+                                 dtype=str(item.batch.image.dtype),
+                                 slack=self.watchdog_slack,
+                                 floor_s=self.watchdog_floor_s,
+                                 default_s=self.watchdog_default_s)
 
     def _quarantine(self, replica: ReplicaState, item: _WorkItem,
                     exc: Exception) -> None:
@@ -346,7 +575,19 @@ class FleetEngine:
             return
         replica.state = REPLICA_QUARANTINED
         replica.error = f"{type(exc).__name__}: {exc}"
+        # the HBM leak fix (ISSUE 13 satellite): a dead replica's params
+        # leave the device NOW, not at process exit — probation re-stages
+        # from the fleet's host-side current-generation copy
+        replica.engine.release_buffers()
+        self._schedule_probe(replica, self._clock())
         self.telemetry.emit("fleet.replica", **replica.snapshot())
+        self._requeue_or_fail(item, exc)
+
+    def _requeue_or_fail(self, item: _WorkItem, exc: Exception) -> None:
+        """The redispatch choreography shared by quarantine and the
+        watchdog: requeue to the FRONT while any live worker can drain
+        it; fail it (and, after the last replica, everything queued)
+        otherwise."""
         stranded = [item]
         with self._cond:
             if self.live_replicas() > 0 and not self._swept:
@@ -379,12 +620,419 @@ class FleetEngine:
                 if not r.done:
                     r.reject(REJECT_ERROR, f"{type(exc).__name__}: {exc}")
 
+    # -- self healing: watchdog + probation + resurrection ----------------
+    def _maintain_loop(self) -> None:
+        from can_tpu.obs import supervised_loop
+
+        supervised_loop(self._maint_stop, self.maintain_interval_s,
+                        self.maintenance_tick, "fleet-maintenance")
+
+    def maintenance_tick(self, now: Optional[float] = None) -> None:
+        """One supervision pass: wedge overdue launches, probe replicas
+        whose cooldown has elapsed.  Runs on the maintenance thread in
+        production; tests drive it directly with a fake clock."""
+        now = self._clock() if now is None else now
+        self._watchdog_sweep(now)
+        self._probe_sweep(now)
+
+    def _watchdog_sweep(self, now: float) -> None:
+        wedged = []
+        with self._cond:
+            for r in list(self.replicas):
+                # DRAINING replicas are covered too: a launch that hangs
+                # during scale-down would otherwise strand its batch
+                # behind remove_replica's bounded join — the zero-drop
+                # contract holds through every transition
+                if (r.state not in (REPLICA_ACTIVE, REPLICA_DRAINING)
+                        or r.inflight is None):
+                    continue
+                item, t0, deadline = r.inflight
+                if now - t0 <= deadline:
+                    continue
+                # overdue: the worker thread is hostage inside a device
+                # execute — mark the replica wedged (it leaves dispatch
+                # the moment its thread next looks), steal the batch,
+                # and send the replica to probation.  The thread is
+                # abandoned, never joined: if the execute ever returns,
+                # _finish_inflight tells it the batch is no longer its.
+                was_draining = r.state == REPLICA_DRAINING
+                r.state = REPLICA_WEDGED
+                r.failures += 1
+                r.error = (f"watchdog: launch exceeded its "
+                           f"{deadline:.3f}s priced deadline")
+                r.inflight = None
+                wedged.append((r, item, was_draining))
+        for r, item, was_draining in wedged:
+            # drop the engine's own param refs NOW (same zero-HBM rule
+            # as quarantine): the stuck execute's runtime references
+            # keep its working set pinned until it returns, but the
+            # Python-side tree must not ALSO pin a copy forever — and
+            # once the execute unwinds, the bytes free immediately
+            r.engine.release_buffers()
+            self.telemetry.emit("fleet.replica", **r.snapshot())
+            exc = ReplicaWedgedError(r.error)
+            item.redispatches += 1
+            if item.redispatches > 1:
+                # second strike (wedged two replicas, or wedged after a
+                # quarantine redispatch): the batch is the poison
+                self._fail(item, exc)
+            else:
+                self._requeue_or_fail(item, exc)
+            if not was_draining:
+                # a draining victim is leaving anyway: remove_replica
+                # owns its teardown — probing it would race a
+                # resurrection against the removal
+                self._schedule_probe(r, now)
+
+    def _schedule_probe(self, replica: ReplicaState, now: float, *,
+                        escalate: bool = False) -> None:
+        """Backoff-with-jitter probation: a fresh quarantine starts at
+        ``probe_cooldown_s``; each failed probe multiplies by
+        ``probe_backoff_factor`` up to ``probe_backoff_max_s``.  Jitter
+        (seeded) keeps a fleet of replicas from probing in lockstep."""
+        if replica.backoff_s is None or not escalate:
+            replica.backoff_s = self.probe_cooldown_s
+        else:
+            replica.backoff_s = min(
+                replica.backoff_s * self.probe_backoff_factor,
+                self.probe_backoff_max_s)
+        jitter = 1.0 + self.probe_jitter * (2.0 * self._rng.random() - 1.0)
+        replica.probe_at = now + replica.backoff_s * jitter
+
+    def _probe_sweep(self, now: float) -> None:
+        """Launch due probes on their OWN daemon threads and fail probes
+        that blew ``probe_timeout_s``.  The maintenance thread never
+        blocks on device work: a probe predict on a still-sick device
+        can hang exactly like the launch that wedged it, and a hung
+        probe must cost one abandoned thread — not the watchdog, the
+        other probes, rollout, and the autoscaler."""
+        if self._warmup_spec is None or self._closed:
+            return
+        due, timed_out = [], []
+        with self._cond:
+            for r in self.replicas:
+                if r.state not in (REPLICA_QUARANTINED, REPLICA_WEDGED):
+                    continue
+                if r.probing is not None:
+                    if now - r.probing > self.probe_timeout_s:
+                        r.probe_token += 1  # a late result cannot swap in
+                        r.probing = None
+                        r.probe_failures += 1
+                        timed_out.append(r)
+                    continue
+                if r.probe_at is not None and now >= r.probe_at:
+                    r.probing = now
+                    r.probe_token += 1
+                    due.append((r, r.probe_token))
+        for r in timed_out:
+            err = f"probe timed out after {self.probe_timeout_s:g}s"
+            self._schedule_probe(r, now, escalate=True)
+            self.telemetry.emit("fleet.probe", replica=r.index, ok=False,
+                                probe_failures=r.probe_failures,
+                                error=err,
+                                next_backoff_s=round(r.backoff_s, 3))
+            self._maybe_page(r, err)
+        for r, token in due:
+            t = threading.Thread(target=self._probe_worker,
+                                 args=(r, token), daemon=True,
+                                 name=f"can-tpu-fleet-probe-r{r.index}")
+            with self._cond:
+                self._probe_threads.append(t)
+            t.start()
+
+    def join_probes(self, timeout_s: float = 60.0) -> None:
+        """Wait (bounded) for in-flight probe threads — the seam
+        deterministic tests drive after a ``maintenance_tick``; a hung
+        probe makes this return at the timeout, never blocks forever."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            threads = list(self._probe_threads)
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        with self._cond:
+            self._probe_threads = [t for t in self._probe_threads
+                                   if t.is_alive()]
+
+    def _maybe_page(self, replica: ReplicaState, error: str) -> None:
+        if replica.probe_failures < self.page_after_probes:
+            return
+        inc = getattr(self.telemetry, "incidents", None)
+        if inc is not None:
+            # the incident manager's per-reason cooldown makes this page
+            # exactly once per cooldown, however often the probe fails
+            inc.trigger("fleet_probe_failed",
+                        detail={"replica": replica.index,
+                                "probe_failures": replica.probe_failures,
+                                "error": error})
+
+    def _build_replica_engine(self, index: int, device) -> ServeEngine:
+        """A fresh engine at the CURRENT generation, staged from the
+        host-side quantized tree, with the AOT table for its device when
+        a bundle is loaded.  Each incarnation gets a distinct program
+        name so its compile_count starts at zero — a recovery-path
+        compile is visible, never absorbed by the old registry."""
+        with self._cond:
+            qparams, qstats = self._host_q
+            n = self._incarnations.get(index, 0)
+            self._incarnations[index] = n + 1
+        name = (f"{self.name}_r{index}" if n == 0
+                else f"{self.name}_r{index}i{n}")
+        return ServeEngine(
+            qparams, qstats, serve_dtype=self.serve_dtype,
+            compute_dtype=self._compute_dtype, ds=self.ds, device=device,
+            quantized=True, telemetry=self.telemetry, name=name,
+            aot_programs=(self._aot.programs_for(device)
+                          if self._aot is not None else None))
+
+    def _probe_worker(self, replica: ReplicaState, token: int) -> None:
+        """One probation attempt, on its own thread: stage current-
+        generation params on the replica's device, run one warm-bucket
+        predict OFF-PATH, warm the full grid, then swap a fresh
+        ReplicaState into dispatch.  Device work happens WITHOUT
+        ``_rollout_lock``; the swap-in re-checks the generation under it
+        (a rollout that landed mid-probe makes the staged weights stale
+        — re-probe promptly rather than serve them)."""
+        gen = self.generation
+        shapes, max_batch, dtypes = self._warmup_spec
+        t0 = time.perf_counter()
+        try:
+            engine = self._build_replica_engine(replica.index,
+                                                replica.device)
+            # the probe proper: ONE warm-bucket predict, off-path — a
+            # sick device/params fails here, not on live traffic
+            from can_tpu.data.batching import pad_batch
+
+            bh, bw = min(shapes)
+            img = np.zeros((bh, bw, 3), dtypes[0])
+            dm = np.zeros((bh // self.ds, bw // self.ds, 1), np.float32)
+            engine.predict_batch(pad_batch([(img, dm)], (bh, bw),
+                                           max_batch, [False], self.ds))
+            rep = engine.warmup(shapes, max_batch, dtypes=dtypes)
+        except Exception as e:  # noqa: BLE001 — probe failure is data
+            with self._cond:
+                if replica.probe_token != token:
+                    return  # timed out / superseded: stale thread
+                started = replica.probing
+                replica.probing = None
+                replica.probe_failures += 1
+            # backoff from the probe's START (the clock that scheduled
+            # it): deterministic under fake clocks, and a slow-failing
+            # probe doesn't stretch its own cooldown
+            now = started if started is not None else self._clock()
+            self._schedule_probe(replica, now, escalate=True)
+            self.telemetry.emit(
+                "fleet.probe", replica=replica.index, ok=False,
+                probe_failures=replica.probe_failures,
+                error=f"{type(e).__name__}: {e}",
+                next_backoff_s=round(replica.backoff_s, 3))
+            self._maybe_page(replica, f"{type(e).__name__}: {e}")
+            return
+        with self._rollout_lock:
+            if self._closed:
+                return
+            if self.generation != gen:
+                # rolled forward mid-probe: discard the stale staging
+                # and re-probe promptly at the new generation
+                with self._cond:
+                    if replica.probe_token == token:
+                        replica.probing = None
+                        replica.probe_at = self._clock()
+                return
+            fresh = ReplicaState(replica.index, replica.device, engine)
+            fresh.generation = gen
+            fresh.failures = replica.failures  # lifetime count survives
+            with self._cond:
+                if (replica.probe_token != token
+                        or replica not in self.replicas):
+                    return  # superseded or retired while we probed
+                replica.probing = None
+                self.replicas[self.replicas.index(replica)] = fresh
+                self._cond.notify_all()
+            # the old ReplicaState (and any abandoned wedged thread
+            # holding it) is now unreachable from dispatch: its _take
+            # sees a non-active state and retires
+            if self._started:
+                self._spawn_worker(fresh)
+            self.telemetry.emit("fleet.probe", replica=replica.index,
+                                ok=True,
+                                probe_failures=replica.probe_failures)
+            self.telemetry.emit(
+                "fleet.resurrect", replica=fresh.index, generation=gen,
+                live=self.live_replicas(),
+                seconds=round(time.perf_counter() - t0, 3),
+                warmup_compiles=rep["compiles"],
+                aot_hits=engine.aot_hits,
+                probe_failures_before=replica.probe_failures)
+            self.telemetry.emit("fleet.replica", **fresh.snapshot())
+
+    # -- autoscaling surface ----------------------------------------------
+    def spare_devices(self) -> list:
+        """Devices of the scale universe not currently owned by any
+        replica (quarantined replicas keep their device: probation will
+        reuse it)."""
+        with self._cond:
+            used = {r.device for r in self.replicas}
+        return [d for d in self._devices_all if d not in used]
+
+    def add_replica(self, *, reason: str = "manual") -> dict:
+        """Grow the fleet by one replica on a spare device, at the
+        current generation, warmed before it joins dispatch — zero-drop
+        by construction (the shared queue never assigned it work until
+        its worker starts pulling).  Returns the scale report (also
+        emitted as ``fleet.scale``, with ``time_to_first_ready_s`` the
+        bench tier records).
+
+        The staging warmup — device work that can hang on a sick spare
+        device — runs under ``_scale_lock`` only: probes, rollout, and
+        the watchdog stay live.  ``_rollout_lock`` is taken briefly for
+        the registration, re-checking the generation: a rollout that
+        landed mid-warmup makes the staged weights stale, and the call
+        raises for the autoscaler to retry rather than admit them."""
+        if self._warmup_spec is None:
+            raise RuntimeError("add_replica before warmup(): the fleet "
+                               "has no (bucket, dtype) grid to warm")
+        with self._scale_lock:
+            if self._closed:
+                raise FleetClosedError("add_replica on a closed fleet")
+            spare = self.spare_devices()
+            if not spare:
+                raise RuntimeError(
+                    f"no spare device: {len(self._devices_all)} device(s) "
+                    f"all owned — the scale universe is the device list "
+                    f"the fleet was built with")
+            dev = spare[0]
+            t0 = time.perf_counter()
+            shapes, max_batch, dtypes = self._warmup_spec
+            with self._cond:
+                index = self._next_index
+                self._next_index = index + 1
+            gen = self.generation
+            engine = self._build_replica_engine(index, dev)
+            rep = engine.warmup(shapes, max_batch, dtypes=dtypes)
+            with self._rollout_lock:
+                if self._closed:
+                    raise FleetClosedError("fleet closed during scale-up")
+                if self.generation != gen:
+                    raise RuntimeError(
+                        "fleet rolled out during scale-up staging — the "
+                        "staged weights are stale; retry add_replica")
+                fresh = ReplicaState(index, dev, engine)
+                fresh.generation = gen
+                with self._cond:
+                    self.replicas.append(fresh)
+                    self._cond.notify_all()
+                if self._started:
+                    self._spawn_worker(fresh)
+                report = {"direction": "up", "replica": index,
+                          "device": str(dev), "reason": reason,
+                          "live": self.live_replicas(),
+                          "generation": gen,
+                          "time_to_first_ready_s":
+                              round(time.perf_counter() - t0, 3),
+                          "warmup_compiles": rep["compiles"],
+                          "aot_hits": engine.aot_hits}
+                self.telemetry.emit("fleet.scale", **report)
+                self.telemetry.emit("fleet.replica", **fresh.snapshot())
+                return report
+
+    def remove_replica(self, *, reason: str = "manual",
+                       drain_timeout_s: float = 60.0) -> dict:
+        """Shrink the fleet by one replica, zero-drop: the victim is
+        marked ``draining`` (its worker finishes the in-flight batch,
+        then retires — queued work belongs to the survivors; a HANG
+        during the drain is still the watchdog's to wedge and
+        re-dispatch), its device buffers are released, and it leaves
+        the replica table entirely (its device returns to the spare
+        pool).  The drain join holds ``_scale_lock`` only, never
+        ``_rollout_lock``."""
+        with self._scale_lock:
+            with self._cond:
+                live = [r for r in self.replicas
+                        if r.state == REPLICA_ACTIVE]
+                if len(live) <= 1:
+                    raise RuntimeError(
+                        "refusing to scale below 1 live replica — close() "
+                        "the fleet instead")
+                victim = live[-1]
+                victim.state = REPLICA_DRAINING
+                self._cond.notify_all()
+            self.telemetry.emit("fleet.replica", **victim.snapshot())
+            t = victim.thread
+            if t is not None:
+                t.join(timeout=drain_timeout_s)
+            victim.engine.release_buffers()
+            with self._rollout_lock:
+                with self._cond:
+                    if victim in self.replicas:
+                        self.replicas.remove(victim)
+            report = {"direction": "down", "replica": victim.index,
+                      "device": str(victim.device), "reason": reason,
+                      "live": self.live_replicas(),
+                      "generation": self.generation}
+            self.telemetry.emit("fleet.scale", **report)
+            return report
+
+    # -- AOT warm start ----------------------------------------------------
+    def bake_aot(self, out_dir: str, *, devices=None) -> dict:
+        """Serialize the warmed (bucket, dtype) predict grid for every
+        device of the scale universe (default) into an AOT bundle at
+        ``out_dir`` — the artifact resurrection and scale-up load
+        executables from.  Live replicas' engines bake their own
+        programs; devices without a replica get a transient staging
+        engine (its params leave with it)."""
+        with self._rollout_lock:
+            if self._warmup_spec is None:
+                raise RuntimeError("bake_aot before warmup(): no "
+                                   "(bucket, dtype) grid to bake")
+            shapes, max_batch, dtypes = self._warmup_spec
+            devices = (list(devices) if devices is not None
+                       else list(self._devices_all))
+            by_dev = {r.device: r.engine for r in self.replicas
+                      if r.state == REPLICA_ACTIVE}
+            qparams, qstats = self._host_q
+            engines = []
+            for dev in devices:
+                eng = by_dev.get(dev)
+                if eng is None:
+                    eng = ServeEngine(
+                        qparams, qstats, serve_dtype=self.serve_dtype,
+                        compute_dtype=self._compute_dtype, ds=self.ds,
+                        device=dev, quantized=True,
+                        telemetry=self.telemetry,
+                        name=f"{self.name}_bake_d{dev.id}")
+                engines.append(eng)
+            return bake_aot_bundle(
+                out_dir, engines=engines, bucket_shapes=shapes,
+                max_batch=max_batch, dtypes=dtypes, ds=self.ds,
+                serve_dtype=self.serve_dtype, sig_sha=self._sig_sha,
+                generation=self.generation, telemetry=self.telemetry)
+
+    def load_aot(self, bundle) -> None:
+        """Attach a bundle (path or ``AotBundle``) for the recovery and
+        scale paths; staleness-checked against the serving tree."""
+        if isinstance(bundle, str):
+            bundle = load_aot_bundle(bundle)
+        bundle.check(sig_sha=self._sig_sha, serve_dtype=self.serve_dtype,
+                     ds=self.ds)
+        with self._rollout_lock:
+            self._aot = bundle
+
     # -- health -----------------------------------------------------------
     def healthz(self) -> dict:
         live = self.live_replicas()
-        return {"ok": live > 0, "replicas": [r.snapshot()
-                                             for r in self.replicas],
+        with self._cond:
+            snaps = [r.snapshot() for r in self.replicas]
+        # generation skew surfaced, not silent: per-replica generation is
+        # in every row, and the serving set's generation spread is a
+        # first-class field (a quarantined-then-resurrected fleet that
+        # somehow serves two checkpoints must be VISIBLE here)
+        serving_gens = sorted({s["generation"] for s in snaps
+                               if s["state"] in (REPLICA_ACTIVE,
+                                                 REPLICA_DRAINING)})
+        return {"ok": live > 0, "replicas": snaps,
                 "live": live, "generation": self.generation,
+                "generations": serving_gens,
+                "mixed_generations": len(serving_gens) > 1,
                 "serve_dtype": self.serve_dtype,
                 "queue_depth": len(self._queue)}
 
@@ -423,14 +1071,17 @@ class FleetEngine:
                          else _replicate(batch_stats, self.devices))
 
             # 3. structural guard BEFORE staging: a tree that would change
-            #    the jit signature would recompile mid-traffic on flip
-            ref = self.replicas[0].engine
+            #    the jit signature would recompile mid-traffic on flip.
+            #    The reference is the HOST-side current tree, not
+            #    replicas[0]'s engine — that replica may be quarantined
+            #    with its buffers released (params None), and a released
+            #    tree must not make every rollout look structural-drifted
             stage_dev = self.devices[-1]
             new_sig = tree_signature((
                 _per_device(rep_params, stage_dev),
                 None if rep_stats is None
                 else _per_device(rep_stats, stage_dev)))
-            old_sig = tree_signature((ref.params, ref.batch_stats))
+            old_sig = tree_signature(self._host_q)
             if new_sig != old_sig:
                 raise ValueError(
                     "rollout refused: the new checkpoint's param tree "
@@ -482,6 +1133,13 @@ class FleetEngine:
                                start=t_f0, end=time.perf_counter())
 
             self.generation = gen
+            # the host-side staging copy follows the fleet: a replica
+            # resurrected or added AFTER this rollout serves generation
+            # ``gen``'s weights, never the boot checkpoint's (the
+            # naive-resurrection staleness this layer exists to close)
+            self._host_q = (host_tree(qparams),
+                            None if batch_stats is None
+                            else host_tree(batch_stats))
             if run_config is not None:
                 self.run_config = run_config
             report = {"generation": gen, "flipped": flipped,

@@ -148,6 +148,16 @@ def compute_dtype_for(serve_dtype: str):
     return jnp.bfloat16 if serve_dtype == "bf16" else None
 
 
+def host_tree(tree):
+    """Device arrays -> host copies, structure/shapes/dtypes preserved
+    (int8 leaf dicts and bf16 leaves included, so ``tree_signature`` of
+    the host copy equals the device tree's).  The fleet keeps the CURRENT
+    generation's quantized tree host-side: resurrection and scale-up can
+    stage params onto ANY device from it, without pinning a replicated
+    copy in every device's HBM for the life of the process."""
+    return jax.device_get(tree)
+
+
 def param_bytes(tree) -> int:
     """Device-resident parameter bytes of a storage tree (the HBM the
     mode actually holds — the artifact's compression receipt)."""

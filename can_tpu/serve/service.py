@@ -206,6 +206,11 @@ class CountService:
             if self._fleet is not None:
                 self._fleet.start()
             self.batcher.start()
+            auto = getattr(self, "autoscaler", None)
+            if auto is not None:
+                # wired by cli/serve.py (or tests): the SLO/queue-driven
+                # scale loop lives and dies with the service
+                auto.start()
             inc = getattr(self.telemetry, "incidents", None)
             if inc is not None:
                 # an incident bundle dumped while this service is alive
@@ -223,6 +228,11 @@ class CountService:
             return
         # can-tpu-lint: disable=LOCKHELD(monotonic flag; a submit racing the flip is rejected by queue.close below)
         self._closed = True
+        auto = getattr(self, "autoscaler", None)
+        if auto is not None:
+            # BEFORE the drain: a scale decision mid-teardown would race
+            # the fleet's close choreography
+            auto.close()
         for r in self.queue.close():
             r.reject(REJECT_SHUTDOWN, "service closing")
             self._count_reject(REJECT_SHUTDOWN)
@@ -311,8 +321,8 @@ class CountService:
             # per-replica rows: service-side work counters joined with the
             # fleet's health snapshot — obs/exporter.py renders these as
             # can_tpu_serve_*{replica="k"} labelled lines
-            health = {r["replica"]: r
-                      for r in self._fleet.healthz()["replicas"]}
+            fh = self._fleet.healthz()
+            health = {r["replica"]: r for r in fh["replicas"]}
             out["replicas"] = {
                 str(k): {**rep_counts.get(k, {"batches": 0,
                                               "completed": 0}),
@@ -320,9 +330,22 @@ class CountService:
                          "failures": h["failures"],
                          "generation": h["generation"]}
                 for k, h in health.items()}
-            out["live_replicas"] = self._fleet.live_replicas()
-            out["generation"] = self._fleet.generation
+            out["live_replicas"] = fh["live"]
+            out["generation"] = fh["generation"]
+            # generation skew is an operator-visible fact, not something
+            # to diff out of the per-replica rows by hand: a fleet
+            # serving two checkpoints at once shows mixed_generations=1
+            # on /stats and the scrape
+            out["mixed_generations"] = bool(fh.get("mixed_generations"))
         return out
+
+    def latency_percentile(self, q: float):
+        """One request-latency percentile under the service lock (the
+        reservoir is a deque the batcher thread appends to; an unlocked
+        read can see it mutate mid-iteration) — the autoscaler's p99
+        signal."""
+        with self._lock:
+            return self.latency.percentile(q)
 
     # -- batcher dispatch (runs on the batcher thread) -------------------
     def _dispatch(self, bucket_hw, batch, requests) -> None:

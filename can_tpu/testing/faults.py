@@ -18,6 +18,9 @@ point every worker at it).  Schema::
          "signal": "SIGTERM"},
         {"kind": "ckpt_io", "op": "save", "fails": 2, "rank": 0},
         {"kind": "rendezvous_timeout", "barrier": "elastic", "rank": 1,
+         "delay_s": 30.0},
+        {"kind": "replica_crash", "replica": 0, "batch": 3},
+        {"kind": "replica_hang", "replica": 1, "batch": 2,
          "delay_s": 30.0}
     ]}
 
@@ -34,11 +37,22 @@ point every worker at it).  Schema::
   for ``delay_s`` before joining, so every OTHER member's bounded
   ``barrier()`` times out for real and raises the typed
   ``RendezvousTimeoutError`` (parallel/runtime.py).
+* ``replica_crash`` — serve-side: when fleet replica ``replica`` is
+  about to execute its ``batch``-th micro-batch (1-based, counted per
+  replica), the hook raises ``InjectedFault`` INSIDE the worker's
+  predict path, so the real quarantine → probation → resurrection
+  choreography (serve/fleet.py) runs exactly as on a device fault.
+  Fires once.
+* ``replica_hang`` — serve-side: the matching (replica, batch) launch
+  SLEEPS ``delay_s`` while holding the replica's dispatch lock — a
+  wedged device execute from the fleet's point of view — so the hang
+  watchdog's priced deadline, batch re-dispatch, and
+  wedged-replica probation run for real.  Fires once.
 
 Hooks are consulted only from sites that already gate on
 ``active_injector()`` (train-loop elastic hook, checkpoint retry loop,
-``runtime.barrier``) — a production run without the env var never
-constructs an injector.
+``runtime.barrier``, the fleet worker's ``on_serve_batch``) — a
+production run without the env var never constructs an injector.
 
 ``make_kill_schedule`` derives the kill step from a seed (the "seeded
 schedule of kill-rank-k-at-step-s"): chaos runs randomise WHERE the
@@ -101,7 +115,8 @@ class FaultInjector:
         for f in faults:
             if not isinstance(f, dict) or "kind" not in f:
                 raise ValueError(f"malformed fault entry: {f!r}")
-            if f["kind"] not in ("kill", "ckpt_io", "rendezvous_timeout"):
+            if f["kind"] not in ("kill", "ckpt_io", "rendezvous_timeout",
+                                 "replica_crash", "replica_hang"):
                 raise ValueError(f"unknown fault kind {f['kind']!r}")
             self.faults.append(dict(f))
         self._ckpt_attempts: Dict[str, int] = {}
@@ -145,6 +160,28 @@ class FaultInjector:
                 raise InjectedFault(
                     f"injected checkpoint {op} I/O error "
                     f"(attempt {n}/{f.get('fails', 1)})")
+
+    def on_serve_batch(self, *, replica: int = 0,
+                       batch_index: int = 1) -> None:
+        """Fleet-worker launch boundary (serve/fleet.py consults this
+        inside the predict try, under the replica's dispatch lock):
+        ``replica_crash`` raises into the quarantine path;
+        ``replica_hang`` sleeps the worker — a wedged execute — into the
+        watchdog's.  ``batch_index`` is 1-based per replica."""
+        for f in self.faults:
+            if (f["kind"] not in ("replica_crash", "replica_hang")
+                    or f.get("_fired")
+                    or int(f.get("replica", 0)) != replica
+                    or int(f.get("batch", 1)) != batch_index):
+                continue
+            f["_fired"] = True
+            self.fired.append(f)
+            if f["kind"] == "replica_hang":
+                time.sleep(float(f.get("delay_s", 30.0)))
+            else:
+                raise InjectedFault(
+                    f"injected replica {replica} crash at batch "
+                    f"{batch_index}")
 
     def on_barrier(self, name: str, *, rank: int = 0) -> None:
         """Barrier entry: the matching rank HOLDS the barrier for

@@ -189,6 +189,17 @@ class StepTimer:
                 "p95_s": float(np.percentile(arr, 95)),
                 "max_s": float(arr.max())}
 
+    def percentile(self, q: float) -> Optional[float]:
+        """One percentile over the reservoir (None when empty) — the
+        autoscaler reads p99 here; ``percentiles()`` stays the fixed
+        p50/p95/max report shape."""
+        if not self._samples:
+            return None
+        import numpy as np
+
+        return float(np.percentile(
+            np.asarray(self._samples, np.float64), q))
+
     def shape_totals(self) -> dict:
         """Raw per-shape accounting, ``{shape: (n, total_s)}`` — the
         lossless feed the ProgramCostLedger joins against compiled-program

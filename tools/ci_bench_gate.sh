@@ -25,6 +25,13 @@
 #       spread floors) and throughput (req/s, gated downward), for
 #       f32/bf16/int8 through the full 2-replica fleet stack
 #
+#   CI_BENCH_ONLY=autoscale tools/ci_bench_gate.sh BENCH_AUTOSCALE_cpu_r13.json
+#       gates the self-healing/autoscale tier: time-to-first-ready for a
+#       recovery-path replica, cold vs AOT-loaded (unit s, duration —
+#       gated on increase), and open-loop p99 THROUGH a mid-run
+#       scale-up (unit ms, fixed offered rate).  Forces cpu8 like the
+#       fleet tier (the scale-up needs a spare device).
+#
 #   CI_BENCH_ONLY=slo tools/ci_bench_gate.sh
 #       gates the SLO layer: tools/slo_report.py grades the committed
 #       fleet-bench-era telemetry fixture (SLO_FIXTURE_cpu_r12.jsonl)
@@ -84,10 +91,11 @@ if [ "$ONLY" = "elastic" ]; then
         -q -p no:cacheprovider
 fi
 
-# the fleet tier pins one device per replica; on the CPU gate box that
-# means the 8-virtual-device smoke mesh (a 1-device run would refuse
+# the fleet tier pins one device per replica (and the autoscale tier's
+# scale-up needs a spare device on top); on the CPU gate box that means
+# the 8-virtual-device smoke mesh (a 1-device run would refuse
 # replicas=2 outright)
-if [ "$ONLY" = "fleet" ]; then
+if [ "$ONLY" = "fleet" ] || [ "$ONLY" = "autoscale" ]; then
     BENCH_SUITE_PLATFORM=${BENCH_SUITE_PLATFORM:-cpu8}
     export BENCH_SUITE_PLATFORM
 fi
@@ -109,10 +117,15 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # BENCH_FLEET_OUT: third instance of the same trap — the fleet tier's
     # artifact defaults to the committed BENCH_FLEET_cpu_r11.json exactly
     # when BENCH_SUITE_ONLY=fleet, which is how this gate runs it.
+    # BENCH_AUTOSCALE_OUT: fourth instance of the baseline-overwrite
+    # trap — the autoscale tier's artifact defaults to the committed
+    # BENCH_AUTOSCALE_cpu_r13.json exactly when BENCH_SUITE_ONLY=
+    # autoscale, which is how this gate runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
         BENCH_FLEET_OUT="${BENCH_FLEET_OUT:-${OUT}.fleet.json}" \
+        BENCH_AUTOSCALE_OUT="${BENCH_AUTOSCALE_OUT:-${OUT}.autoscale.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi
