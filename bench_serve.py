@@ -266,15 +266,18 @@ def main() -> None:
                               deadline_ms)
     stats = service.stats()
 
-    # compile budget: one program per (bucket, dtype) PER replica engine
-    compile_budget = len(buckets) * max(replicas, 1)
+    # compile budget: one program per (bucket, menu size, dtype) PER
+    # replica engine (the r14 sub-batch menu rides the warmup)
+    menu = service.sched.menu if service.sched is not None else (max_batch,)
+    compile_budget = len(buckets) * max(replicas, 1) * len(menu)
     report = {
         "metric": f"cannet_serve_b{max_batch}_w{int(max_wait_ms)}ms"
                   + (f"_r{replicas}" if fleet else "")
                   + (f"_{serve_dtype}" if serve_dtype != "f32" else ""),
         "unit": "ms latency / req_s",
         "config": {"requests": n_requests, "clients": n_clients,
-                   "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+                   "max_batch": max_batch, "menu": list(menu),
+                   "max_wait_ms": max_wait_ms,
                    "deadline_ms": deadline_ms,
                    "replicas": replicas if fleet else 1,
                    "serve_dtype": serve_dtype,

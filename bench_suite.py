@@ -927,12 +927,16 @@ def bench_serve_fleet(*, replicas=2, modes=("f32", "bf16", "int8"),
         spread = lambda xs: round(  # noqa: E731
             100.0 * (max(xs) - min(xs)) / max(statistics.median(xs), 1e-9),
             1)
+        # compile budget is menu-aware since r14: one program per
+        # (bucket, menu size, dtype) per replica (can_tpu/sched)
+        menu_len = len(svc.sched.menu) if svc.sched is not None else 1
         base = {"replicas": replicas, "serve_dtype": mode,
                 "offered_rps": rate_rps, "requests": n_requests,
                 "repeats": repeats, "rejects": rejects,
                 "warmup_compiles": warm["compiles"],
                 "compiles_bounded":
-                    fleet.compile_count <= len(buckets) * replicas,
+                    fleet.compile_count
+                    <= len(buckets) * replicas * menu_len,
                 "param_bytes": param_bytes(
                     fleet.replicas[0].engine.params),
                 "replica_batches": {k: v["batches"]
@@ -970,6 +974,156 @@ def bench_serve_fleet(*, replicas=2, modes=("f32", "bf16", "int8"),
     print(f"# fleet tier: {len(records)} records over {len(modes)} modes "
           f"-> {out}", flush=True)
     return records
+
+
+def bench_sched(*, n_requests=32, repeats=3, max_batch=4,
+                max_wait_ms=50.0, out_path=None) -> list:
+    """Scheduling-core tier (r14): serve fill % / p99 / time-to-flush at
+    LOW and MIXED load through the priced menu+flush core
+    (can_tpu/sched), with the pre-r14 timer+pad-to-max arm measured in
+    the SAME run as context — the committed artifact is the receipt
+    that fill strictly improved at both loads with p99 no worse.
+
+    Single engine on one device (runs on the plain CI box: no cpu8);
+    mixed load reuses the fleet tier's offered-rate discipline (fixed
+    rate below saturation so p99 is comparable run-to-run).  Gated
+    records: ``serve_sched_fill_{low,mixed}`` (unit ``fill_pct``,
+    bench_compare gates DOWNWARD only — fill dropping is the
+    regression), ``serve_sched_p99_{low,mixed}`` (ms, upward),
+    ``serve_sched_ttf_p95_low`` (ms, upward: submit->assembly wait at
+    low load, the time-to-flush distribution vs the old timer), and
+    ``serve_sched_rps_mixed`` (req/s, downward).  Each record carries
+    the legacy arm's number as ``legacy_*`` context plus the
+    predicted==realized receipt (``cost_mismatches`` must be 0)."""
+    import statistics
+
+    import jax
+
+    from bench_serve import run_open_loop
+    from can_tpu.models import cannet_init
+    from can_tpu.obs import Telemetry
+    from can_tpu.serve import CountService, ServeEngine, prepare_image
+
+    low_rate = float(os.environ.get("BENCH_SCHED_LOW_RATE", "2"))
+    mixed_rate = float(os.environ.get("BENCH_SCHED_MIXED_RATE", "4"))
+    params = cannet_init(jax.random.key(0))
+    sizes = [(64, 64), (96, 64)]
+    ladder = (tuple(sorted({h for h, _ in sizes})),
+              tuple(sorted({w for _, w in sizes})))
+    buckets = [(h, w) for h in ladder[0] for w in ladder[1]]
+    rng = np.random.default_rng(7)
+    images = [prepare_image(
+        (rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8))
+        for h, w in sizes]
+
+    def run_arm(tag, **svc_kw):
+        mism = [0]
+        tel = Telemetry([_SchedMismatchSink(mism)])
+        engine = ServeEngine(params, telemetry=tel, name=f"sched_{tag}")
+        svc = CountService(engine, max_batch=max_batch,
+                           max_wait_ms=max_wait_ms, queue_capacity=256,
+                           bucket_ladder=ladder, telemetry=tel, **svc_kw)
+        warm = svc.warmup(buckets)
+        out = {"warmup_compiles": warm["compiles"]}
+        with svc:
+            for phase, rate in (("low", low_rate), ("mixed", mixed_rate)):
+                p99s, rpss, fills, ttfs = [], [], [], []
+                for rep in range(repeats):
+                    before = svc.stats()
+                    o = run_open_loop(svc, images, n_requests, rate,
+                                      deadline_ms=30_000, seed=rep)
+                    after = svc.stats()
+                    slots = after["batch_slots"] - before["batch_slots"]
+                    valid = after["batch_valid"] - before["batch_valid"]
+                    p99s.append(o["p99_ms"])
+                    rpss.append(o["throughput_rps"])
+                    fills.append(100.0 * valid / max(slots, 1))
+                    if o["queue_wait_p95_ms"] is not None:
+                        ttfs.append(o["queue_wait_p95_ms"])
+                out[phase] = {"p99_ms": p99s, "rps": rpss, "fill": fills,
+                              "ttf_p95_ms": ttfs}
+        out["cost_mismatches"] = mism[0]
+        out["compile_count"] = engine.compile_count
+        return out
+
+    # the priced arm (the r14 default) and the pre-r14 timer+pad arm,
+    # same run, same offered traffic — the improvement receipt
+    sched_arm = run_arm("priced")
+    legacy_arm = run_arm("legacy", menu_budget=1, flush_policy="timer")
+
+    med = statistics.median
+    spread = lambda xs: round(  # noqa: E731
+        100.0 * (max(xs) - min(xs)) / max(abs(med(xs)), 1e-9), 1)
+    base = {"requests": n_requests, "repeats": repeats,
+            "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+            "low_rate_rps": low_rate, "mixed_rate_rps": mixed_rate,
+            "conditions": "fleet_r11-style fixed offered rate, 30s "
+                          "deadline, buckets 64x64/96x64",
+            "cost_mismatches": sched_arm["cost_mismatches"],
+            "warmup_compiles": sched_arm["warmup_compiles"]}
+    records = []
+
+    def rec(metric, vals, unit, **extra):
+        records.append({"metric": metric, "value": round(med(vals), 3),
+                        "unit": unit, "spread_pct": spread(vals),
+                        **base, **extra})
+
+    rec("serve_sched_fill_low", sched_arm["low"]["fill"], "fill_pct",
+        legacy_fill=round(med(legacy_arm["low"]["fill"]), 2))
+    rec("serve_sched_fill_mixed", sched_arm["mixed"]["fill"], "fill_pct",
+        legacy_fill=round(med(legacy_arm["mixed"]["fill"]), 2))
+    rec("serve_sched_p99_low", sched_arm["low"]["p99_ms"], "ms",
+        legacy_p99_ms=round(med(legacy_arm["low"]["p99_ms"]), 3))
+    rec("serve_sched_p99_mixed", sched_arm["mixed"]["p99_ms"], "ms",
+        legacy_p99_ms=round(med(legacy_arm["mixed"]["p99_ms"]), 3))
+    rec("serve_sched_ttf_p95_low", sched_arm["low"]["ttf_p95_ms"], "ms",
+        legacy_ttf_p95_ms=round(med(legacy_arm["low"]["ttf_p95_ms"]), 3))
+    rec("serve_sched_rps_mixed", sched_arm["mixed"]["rps"], "req/s",
+        legacy_rps=round(med(legacy_arm["mixed"]["rps"]), 2))
+    for r in records:
+        if _TELEMETRY is not None:
+            _TELEMETRY.emit("bench", **r)
+        print(json.dumps(r), flush=True)
+
+    out = out_path or os.environ.get("BENCH_SCHED_OUT")
+    if not out:
+        # committed gate baseline only for an explicit sched-only run
+        # (the perf/bn/fleet/autoscale no-self-overwrite rule, 5th use)
+        out = ("BENCH_SCHED_cpu_r14.json"
+               if os.environ.get("BENCH_SUITE_ONLY") == "sched"
+               else "BENCH_SCHED_local.json")
+    doc = {"metric": "serve_sched",
+           "config": {**base,
+                      "platform": jax.devices()[0].platform},
+           "legacy_arm": {k: legacy_arm[k] for k in ("low", "mixed",
+                                                     "warmup_compiles",
+                                                     "cost_mismatches")},
+           "results": records}
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# sched tier: {len(records)} records -> {out}", flush=True)
+    return records
+
+
+class _SchedMismatchSink:
+    """Counts serve.batch events whose predicted cost != realized cost —
+    the core's invariant, carried as a receipt in the sched artifact."""
+
+    def __init__(self, counter):
+        self._c = counter
+
+    def emit(self, event):
+        from can_tpu.sched.core import costs_match
+
+        if event.get("kind") != "serve.batch":
+            return
+        p = event.get("payload", {})
+        if not costs_match(p.get("predicted_cost_px"),
+                           p.get("realized_cost_px")):
+            self._c[0] += 1
+
+    def close(self):
+        pass
 
 
 def bench_autoscale(*, replicas=2, n_requests=32, repeats=3, max_batch=4,
@@ -1024,9 +1178,13 @@ def bench_autoscale(*, replicas=2, n_requests=32, repeats=3, max_batch=4,
     fleet = FleetEngine(params, replicas=replicas, telemetry=tel,
                         name="autoscale_fleet",
                         devices=jax.devices()[:need])
+    # pinned to the pre-r14 single-size/timer config: this tier measures
+    # AOT vs cold recovery mechanics, and its committed r13 baseline was
+    # recorded at one program per (bucket, dtype) — the scheduler's own
+    # tier (bench_sched) measures the menu
     svc = CountService(fleet, max_batch=max_batch, max_wait_ms=2.0,
                        queue_capacity=256, bucket_ladder=ladder,
-                       telemetry=tel)
+                       telemetry=tel, menu_budget=1, flush_policy="timer")
     warm = svc.warmup(buckets)
     with tempfile.TemporaryDirectory() as aot_dir:
         manifest = fleet.bake_aot(aot_dir)
@@ -1225,6 +1383,8 @@ def main() -> None:
             bench_serve_fleet(n_requests=16, repeats=2)
         if want("autoscale"):
             bench_autoscale(n_requests=16, repeats=2)
+        if want("sched"):
+            bench_sched(n_requests=16, repeats=2)
     else:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=16, h=576, w=768, steps=20)
@@ -1274,6 +1434,10 @@ def main() -> None:
             # same reproducible-on-the-gate-box rule
             # (BENCH_AUTOSCALE_cpu_r13.json)
             bench_autoscale()
+        if want("sched"):
+            # scheduling-core tier: single engine, no cpu8 needed
+            # (BENCH_SCHED_cpu_r14.json)
+            bench_sched()
 
     if _TELEMETRY is not None:
         from can_tpu.obs import emit_memory

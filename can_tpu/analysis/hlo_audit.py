@@ -74,6 +74,17 @@ AUDIT_DP, AUDIT_SP = 2, 4
 # cannot silently change the compiled program's collective structure —
 # the re-formed world's psums/packing are pinned, not assumed.
 AUDIT_DP_SHRUNK = 1
+# the serve sub-batch menu programs are pinned from ONE registry
+# (can_tpu/sched.default_serve_menu — the same call warmup, the AOT bake,
+# and the batcher's covers derive from): for each serve dtype, one
+# contracted program per menu size at this max_batch.  A menu changed
+# outside the registry shows up as a registry/contract mismatch and
+# turns the audit red (the r14 mutation test).
+AUDIT_SERVE_MAX_BATCH = 2
+# ceiling on the total contracted program count (enforced when the
+# committed contract carries "program_budget"): program families — and
+# the serve menu especially — must grow by DECISION, not accretion
+DEFAULT_PROGRAM_BUDGET = 16
 
 
 class AuditError(Exception):
@@ -363,12 +374,14 @@ def _lower_eval():
     return step.lower(params, batch)
 
 
-def serve_predict_lowerable(serve_dtype: str):
+def serve_predict_lowerable(serve_dtype: str,
+                            batch_size: int = AUDIT_SERVE_MAX_BATCH):
     """(jitted predict, lowering args) for a fresh ServeEngine in this
-    mode — via the same ``jit_for`` hook the cost ledger uses, so the
-    audited program IS the one a replica executes.  Exposed (not just
-    used by the registry) so the mutation tests can lower variants —
-    e.g. feeding PRE-dequantized params to simulate a hoisted dequant."""
+    mode at one menu batch size — via the same ``jit_for`` hook the cost
+    ledger uses, so the audited program IS the one a replica executes.
+    Exposed (not just used by the registry) so the mutation tests can
+    lower variants — e.g. feeding PRE-dequantized params to simulate a
+    hoisted dequant."""
     import jax
     import numpy as np
 
@@ -382,14 +395,46 @@ def serve_predict_lowerable(serve_dtype: str):
     h, w = AUDIT_HW
     img = np.zeros((h, w, 3), np.float32)
     dm = np.zeros((h // 8, w // 8, 1), np.float32)
-    batch = _batch_dict(pad_batch([(img, dm)], (h, w), 2, [False], 8))
+    batch = _batch_dict(pad_batch([(img, dm)], (h, w), int(batch_size),
+                                  [False], 8))
     args = (eng.params, batch, eng.batch_stats)
     return resolve_jit(eng._predict, args), args
 
 
-def _lower_serve(serve_dtype: str):
-    fn, args = serve_predict_lowerable(serve_dtype)
+def _lower_serve(serve_dtype: str,
+                 batch_size: int = AUDIT_SERVE_MAX_BATCH):
+    fn, args = serve_predict_lowerable(serve_dtype, batch_size)
     return fn.lower(*args)
+
+
+def serve_menu_sizes():
+    """The audited serve batch sizes — THE registry call
+    (can_tpu/sched.default_serve_menu at the audit's max_batch).  The
+    contracted serve program set derives from this at audit time, so a
+    menu change anywhere (including after import) diverges from the
+    committed contract and fails the audit."""
+    from can_tpu.sched import default_serve_menu
+
+    return default_serve_menu(AUDIT_SERVE_MAX_BATCH)
+
+
+SERVE_DTYPES_AUDITED = ("f32", "bf16", "int8")
+
+
+def serve_program_name(serve_dtype: str, size: int) -> str:
+    """Top menu size keeps the historical name (``serve_predict_f32``);
+    the sub-batch menu sizes are suffixed (``serve_predict_f32_b1``)."""
+    base = f"serve_predict_{serve_dtype}"
+    return base if size == AUDIT_SERVE_MAX_BATCH else f"{base}_b{size}"
+
+
+def expected_serve_programs() -> Dict[str, object]:
+    """name -> builder for every (dtype, menu size) serve program, from
+    the LIVE registry menu."""
+    return {serve_program_name(dt, s):
+            (lambda dt=dt, s=s: _lower_serve(dt, s))
+            for dt in SERVE_DTYPES_AUDITED
+            for s in serve_menu_sizes()}
 
 
 PROGRAM_BUILDERS = {
@@ -404,9 +449,8 @@ PROGRAM_BUILDERS = {
     "train_step_syncbn_twopass_dp1": lambda: _lower_sp_syncbn(
         "twopass", dp=AUDIT_DP_SHRUNK),
     "eval_step_f32": _lower_eval,
-    "serve_predict_f32": lambda: _lower_serve("f32"),
-    "serve_predict_bf16": lambda: _lower_serve("bf16"),
-    "serve_predict_int8": lambda: _lower_serve("int8"),
+    # the serve menu programs, from the one registry
+    **expected_serve_programs(),
 }
 
 
@@ -503,6 +547,30 @@ def audit_programs(contract: dict,
                 name, "program_contracted", "a contract entry", "absent",
                 "the registry builds a program the contract does not "
                 "guard — add it via --update"))
+        # the serve menu is pinned from ONE registry call
+        # (sched.default_serve_menu): the LIVE menu's program set must
+        # equal both the import-time registry and the contract — a menu
+        # changed outside the registry path (or after import) turns the
+        # audit red here, with the divergent sizes named
+        live = sorted(expected_serve_programs())
+        contracted = sorted(n for n in contract["programs"]
+                            if n.startswith("serve_predict"))
+        registered = sorted(n for n in PROGRAM_BUILDERS
+                            if n.startswith("serve_predict"))
+        if live != contracted or live != registered:
+            violations.append(Violation(
+                "<serve menu>", "serve_menu_registry",
+                contracted, live,
+                "the serve sub-batch menu diverged from the committed "
+                "contract — menu changes go through "
+                "sched.default_serve_menu + --update, never around them"))
+        budget = contract.get("program_budget")
+        if budget is not None and len(PROGRAM_BUILDERS) > int(budget):
+            violations.append(Violation(
+                "<registry>", "program_budget", f"<= {int(budget)}",
+                len(PROGRAM_BUILDERS),
+                "the registry grew past the committed program-count "
+                "budget — raise it intentionally via --update + commit"))
     for name in (sorted(contract["programs"]) if names is None
                  else names):
         entry = contract["programs"].get(name)
@@ -547,11 +615,13 @@ def build_contract(names: Optional[Sequence[str]] = None, *,
         programs[name] = entry
     return {
         "version": CONTRACT_VERSION,
+        "program_budget": DEFAULT_PROGRAM_BUDGET,
         "generated": {
             "jax": jax.__version__,
             "backend": jax.devices()[0].platform,
             "image_hw": list(AUDIT_HW),
             "mesh": {"dp": AUDIT_DP, "sp": AUDIT_SP},
+            "serve_menu": list(serve_menu_sizes()),
             "with_cost": bool(with_cost),
         },
         "programs": programs,

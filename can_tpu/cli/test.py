@@ -334,12 +334,15 @@ def main(argv=None) -> int:
             eval_step = make_dp_eval_step(cannet_apply, mesh,
                                           compute_dtype=compute_dtype)
         try:
+            from can_tpu.sched import prefetch_depth_for
+
             metrics = evaluate(eval_step, params, batcher.epoch(0),
                                put_fn=lambda b: make_global_batch(
                                    b, mesh, spatial=args.sp > 1),
                                dataset_size=batcher.dataset_size,
                                show_progress=True, batch_stats=batch_stats,
-                               telemetry=loop_tel)
+                               telemetry=loop_tel,
+                               prefetch=prefetch_depth_for(batcher))
         finally:
             batcher.close()
         telemetry.emit("epoch", step=0, phase="eval", mae=metrics["mae"],

@@ -740,6 +740,18 @@ def _run_elastic_generations(args, run_cfg, topo, *, supervisor,
         # at the new schedule granularity — both recorded in the
         # elastic.transition event.
         steps_per_epoch = train_batcher.batches_per_epoch(0)
+        # priced prefetch depth (the scheduling core's 4th consumer):
+        # tiny launches amortise the per-launch dispatch overhead over
+        # little compute and need a deeper host pipeline; the historical
+        # depth=2 is exactly what the pricing returns for normal batches
+        from can_tpu.sched import prefetch_depth_for
+
+        prefetch = prefetch_depth_for(train_batcher)
+        # computed ONCE per generation: the depth is a pure function of
+        # the batcher's epoch-invariant schedule, and global_schedule(0)
+        # is an O(dataset) rebuild — not something the per-epoch eval
+        # block should pay
+        eval_prefetch = prefetch_depth_for(test_batcher)
         schedule = make_lr_schedule(args.lr, world_size=dp,
                                     total_steps=args.epochs * steps_per_epoch,
                                     lrf=args.lrf)
@@ -1009,7 +1021,8 @@ def _run_elastic_generations(args, run_cfg, topo, *, supervisor,
                             train_step, state, batches, put_fn=put,
                             epoch=epoch, show_progress=main_proc,
                             total=total, telemetry=loop_tel,
-                            health=health, on_step=on_step)
+                            health=health, on_step=on_step,
+                            prefetch=prefetch)
                     except el.ElasticInterrupt as interrupt:
                         # the agreed shrink point: flush any in-flight
                         # async save FIRST (its arrays must reach disk
@@ -1067,7 +1080,8 @@ def _run_elastic_generations(args, run_cfg, topo, *, supervisor,
                             put_fn=put,
                             dataset_size=test_batcher.dataset_size,
                             batch_stats=state.batch_stats,
-                            telemetry=loop_tel)
+                            telemetry=loop_tel,
+                            prefetch=eval_prefetch)
                         mae = metrics["mae"]
                         epoch_metrics.update(mae=mae, mse=metrics["mse"])
                     # through the bus: MetricLoggerSink forwards scalars

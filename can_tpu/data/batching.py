@@ -241,6 +241,12 @@ class ShardedBatcher:
         # times (progress total, epoch(), a possible second shrink) and
         # each build pays an uncached planner run over the subset
         self._subset_cache: Optional[Tuple[Tuple[int, frozenset], list]] = None
+        # last FULL epoch schedule, keyed by epoch: batches_per_epoch,
+        # the epoch iterator, planner_stats, and the r14 prefetch
+        # pricing all ask for the same epoch's schedule — each rebuild
+        # is an O(dataset) sort+group, and the schedule is a pure
+        # function of (seed, epoch, histogram)
+        self._epoch_cache: Optional[Tuple[int, list]] = None
         # host loader threads (the reference's DataLoader num_workers,
         # train.py:90, done with threads: PIL decode / cv2 resize release
         # the GIL, and threads share the process — no pickling, no fork
@@ -433,7 +439,7 @@ class ShardedBatcher:
         buckets) candidate grids and may not cost O(n_items) Python per
         grid on large datasets).  Warnings stay silent here (only the
         CHOSEN ladder's plan warns, via _partial_plan)."""
-        from can_tpu.data.planner import GlobalPlanner
+        from can_tpu.sched import offline_planner
 
         hb, wb = ladder
         hs = np.asarray([h for h, _ in shapes])
@@ -450,9 +456,9 @@ class ShardedBatcher:
             axis=0, return_counts=True)
         counts = {(int(h), int(w)): int(c)
                   for (h, w), c in zip(cells, ncell)}
-        planner = GlobalPlanner(self._cost_model(),
-                                max_buckets=self.max_buckets,
-                                mode=self.plan_mode)
+        planner = offline_planner(self._cost_model(),
+                                  max_buckets=self.max_buckets,
+                                  mode=self.plan_mode)
         return planner.plan_with_fallback(counts).cost
 
     def padding_overhead(self) -> float:
@@ -573,8 +579,12 @@ class ShardedBatcher:
         REMAINDER's (the uncovered items of an interrupted epoch,
         replanned at the new world's quantum; ``global_schedule``'s
         ``include`` path).  A pure function of (counts, cost model,
-        budget), so every host derives the identical plan."""
-        from can_tpu.data.planner import GlobalPlanner
+        budget), so every host derives the identical plan.  Construction
+        routes through the scheduling core (``sched.offline_planner`` —
+        the r14 one-core refactor); plans are bit-identical to the
+        pre-r14 direct ``GlobalPlanner`` (pinned by the legacy
+        comparator in tests/test_sched.py)."""
+        from can_tpu.sched import offline_planner
 
         def warn(msg):
             tag = msg[:40]
@@ -582,9 +592,9 @@ class ShardedBatcher:
                 self._cap_warned.add(tag)
                 print(f"[batching] WARNING: {msg}")
 
-        planner = GlobalPlanner(self._cost_model(),
-                                max_buckets=self.max_buckets,
-                                mode=self.plan_mode, warn=warn)
+        planner = offline_planner(self._cost_model(),
+                                  max_buckets=self.max_buckets,
+                                  mode=self.plan_mode, warn=warn)
         return planner.plan_with_fallback(counts)
 
     def _partial_plan(self):
@@ -668,7 +678,12 @@ class ShardedBatcher:
         and computes the identical plan; the last subset schedule is
         memoised (the resume leg asks for it 2-3 times)."""
         if include is None:
-            return self._build_schedule(epoch, None)
+            if self._epoch_cache is not None \
+                    and self._epoch_cache[0] == epoch:
+                return self._epoch_cache[1]
+            sched = self._build_schedule(epoch, None)
+            self._epoch_cache = (epoch, sched)
+            return sched
         key = (epoch, frozenset(int(i) for i in include))
         if self._subset_cache is not None and self._subset_cache[0] == key:
             return self._subset_cache[1]

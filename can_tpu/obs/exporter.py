@@ -172,6 +172,31 @@ class GaugeSink:
                 self._count((f"{pre}_health_alerts_total",
                              (("signal", str(p.get("signal", "?"))),
                               ("kind", str(p.get("alert", "?"))))))
+            elif kind == "serve.batch":
+                # scheduler economics (can_tpu/sched): per-flush fill %
+                # and dead slots, plus the predicted-vs-realized launch
+                # cost the core's invariant rides on — a mismatch count
+                # above zero is a scheduling bug, live on the scrape
+                if p.get("fill_pct") is not None:
+                    self._gauges[f"{pre}_sched_fill_pct"] = \
+                        float(p["fill_pct"])
+                self._count((f"{pre}_sched_batches_total", ()))
+                self._count((f"{pre}_sched_slots_total", ()),
+                            float(p.get("size", 0)))
+                self._count((f"{pre}_sched_padded_slots_total", ()),
+                            float(p.get("padded_slots", 0)))
+                pred = p.get("predicted_cost_px")
+                real = p.get("realized_cost_px")
+                if pred is not None and real is not None:
+                    self._count((f"{pre}_sched_predicted_cost_px_total",
+                                 ()), float(pred))
+                    self._count((f"{pre}_sched_realized_cost_px_total",
+                                 ()), float(real))
+                    from can_tpu.sched.core import costs_match
+
+                    if not costs_match(pred, real):
+                        self._count(
+                            (f"{pre}_sched_cost_mismatch_total", ()))
             elif kind == "data.planner":
                 # batch-planner economics (ShardedBatcher.planner_stats):
                 # padding/schedule overhead, program + lowered-launch

@@ -72,6 +72,11 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_slots = 0
     serve_valid = 0
     serve_queue_depth_max = None
+    # scheduling core (can_tpu/sched): per-flush economics off serve.batch
+    sched_padded = 0
+    sched_pred_px = 0.0
+    sched_real_px = 0.0
+    sched_mismatches = 0
     perf_last: Optional[dict] = None
     span_names: dict = {}
     fleet_rollouts = 0
@@ -139,6 +144,15 @@ def summarize(events: Iterable[dict]) -> dict:
             serve_batches += 1
             serve_slots += int(p.get("size", 0))
             serve_valid += int(p.get("valid", 0))
+            sched_padded += int(p.get("padded_slots", 0))
+            if p.get("predicted_cost_px") is not None:
+                from can_tpu.sched.core import costs_match
+
+                sched_pred_px += float(p["predicted_cost_px"])
+                sched_real_px += float(p.get("realized_cost_px", 0.0))
+                if not costs_match(p["predicted_cost_px"],
+                                   p.get("realized_cost_px", 0.0)):
+                    sched_mismatches += 1
             depth = p.get("queue_depth")
             if depth is not None:
                 d = int(depth)
@@ -233,6 +247,13 @@ def summarize(events: Iterable[dict]) -> dict:
         "serve_rejects": sum(serve_rejects.values()),
         "serve_rejects_by_reason": dict(sorted(serve_rejects.items())),
         "serve_queue_depth_max": serve_queue_depth_max,
+        # scheduling core (can_tpu/sched); Nones/zeros pre-r14 artifacts
+        "sched_fill_pct": (round(100.0 * serve_valid / serve_slots, 2)
+                           if serve_slots else None),
+        "sched_padded_slots": sched_padded,
+        "sched_predicted_cost_px": round(sched_pred_px, 1),
+        "sched_realized_cost_px": round(sched_real_px, 1),
+        "sched_cost_mismatches": sched_mismatches,
         # per-request breakdown (from the span timestamps; Nones pre-r9)
         "serve_queue_wait_p50_s": _percentile(serve_queue_wait, 50),
         "serve_queue_wait_p95_s": _percentile(serve_queue_wait, 95),
@@ -468,6 +489,18 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                 ("serve breakdown",
                  f"queue_wait p95={_fmt(summary['serve_queue_wait_p95_s'])} s"
                  f" device p95={_fmt(summary['serve_device_p95_s'])} s"))
+        if summary.get("sched_fill_pct") is not None:
+            # the scheduling core's per-flush economics (can_tpu/sched):
+            # fill %, dead slots, and the predicted==realized invariant
+            mism = summary.get("sched_cost_mismatches", 0)
+            rows.append(
+                ("scheduler",
+                 f"fill={_fmt(summary['sched_fill_pct'])}% "
+                 f"padded_slots={summary['sched_padded_slots']} "
+                 f"predicted={_fmt(summary['sched_predicted_cost_px'])}px "
+                 f"realized={_fmt(summary['sched_realized_cost_px'])}px "
+                 + ("predicted==realized" if not mism
+                    else f"MISMATCHES={mism}")))
     if (summary.get("fleet_rollouts") or summary.get("fleet_quarantines")
             or summary.get("fleet_replica_states")):
         states = summary.get("fleet_replica_states") or {}

@@ -18,9 +18,11 @@ granularity::
         print(res.count, res.latency_s)
 
 Guarantees: every submitted request resolves or is rejected with a typed
-reason (never hangs); compile count == distinct (bucket, dtype) programs,
-all paid in ``warmup``; a served count is bit-for-bit what ``evaluate()``
-computes offline for the same image and params.
+reason (never hangs); compile count == distinct (bucket, menu size,
+dtype) programs — the launch-size menu comes from the shared scheduling
+core (``can_tpu/sched``, r14) — all paid in ``warmup``; a served count
+is bit-for-bit what ``evaluate()`` computes offline for the same image
+and params at the same launch size.
 """
 
 from .aot import AotBundle, AotStaleError, load_aot_bundle

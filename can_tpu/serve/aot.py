@@ -78,22 +78,30 @@ def _program_filename(device_id: int, shape: Tuple[int, ...],
 def bake_aot_bundle(out_dir: str, *, engines: Sequence, bucket_shapes,
                     max_batch: int, dtypes, ds: int, serve_dtype: str,
                     sig_sha: str, generation: int = 0,
-                    telemetry=None) -> dict:
-    """Serialize every (bucket, dtype) predict executable of every engine.
+                    telemetry=None, batch_sizes=None) -> dict:
+    """Serialize every (bucket, size, dtype) predict executable of every
+    engine.
 
     ``engines``: ``ServeEngine``s, one per target device (their committed
-    params pin the compiled device assignment).  Each program is
-    lower+compiled fresh (``ServeEngine.compile_program`` — the
-    cost-ledger precedent: a second compile on the already-slow bake
-    path, deduped by the persistent compilation cache where armed) and
-    serialized with its arg trees.  Returns the manifest."""
+    params pin the compiled device assignment).  ``batch_sizes`` is the
+    scheduling core's launch-size menu (None = just ``max_batch``) — the
+    menu RIDES the bake axes, so a loaded bundle covers every size the
+    batcher may dispatch and a menu change invalidates the bundle
+    instead of hiding live compiles.  Each program is lower+compiled
+    fresh (``ServeEngine.compile_program`` — the cost-ledger precedent:
+    a second compile on the already-slow bake path, deduped by the
+    persistent compilation cache where armed) and serialized with its
+    arg trees.  Returns the manifest."""
     import jax
     import numpy as np
 
     from can_tpu.data.batching import pad_batch
 
+    from can_tpu.sched import normalize_sizes
+
     os.makedirs(out_dir, exist_ok=True)
     shapes = sorted(set(map(tuple, bucket_shapes)))
+    sizes = normalize_sizes(max_batch, batch_sizes)
     programs: List[dict] = []
     t0 = time.perf_counter()
     platform = device_kind = None
@@ -102,22 +110,23 @@ def bake_aot_bundle(out_dir: str, *, engines: Sequence, bucket_shapes,
         platform = dev.platform
         device_kind = dev.device_kind
         for bh, bw in shapes:
-            for dt in dtypes:
-                img = np.zeros((bh, bw, 3), dt)
-                dm = np.zeros((bh // ds, bw // ds, 1), np.float32)
-                batch = pad_batch([(img, dm)], (bh, bw), max_batch,
-                                  [False], ds)
-                payload, meta = engine.serialize_program(batch)
-                fname = _program_filename(dev.id, batch.image.shape,
-                                          str(batch.image.dtype))
-                with open(os.path.join(out_dir, fname), "wb") as f:
-                    f.write(payload)
-                programs.append({"device_id": int(dev.id),
-                                 "shape": [int(d)
-                                           for d in batch.image.shape],
-                                 "dtype": str(batch.image.dtype),
-                                 "file": fname,
-                                 "bytes": len(payload), **meta})
+            for size in sizes:
+                for dt in dtypes:
+                    img = np.zeros((bh, bw, 3), dt)
+                    dm = np.zeros((bh // ds, bw // ds, 1), np.float32)
+                    batch = pad_batch([(img, dm)], (bh, bw), size,
+                                      [False], ds)
+                    payload, meta = engine.serialize_program(batch)
+                    fname = _program_filename(dev.id, batch.image.shape,
+                                              str(batch.image.dtype))
+                    with open(os.path.join(out_dir, fname), "wb") as f:
+                        f.write(payload)
+                    programs.append({"device_id": int(dev.id),
+                                     "shape": [int(d)
+                                               for d in batch.image.shape],
+                                     "dtype": str(batch.image.dtype),
+                                     "file": fname,
+                                     "bytes": len(payload), **meta})
     manifest = {
         "version": AOT_VERSION,
         "jax_version": jax.__version__,
@@ -126,6 +135,7 @@ def bake_aot_bundle(out_dir: str, *, engines: Sequence, bucket_shapes,
         "serve_dtype": serve_dtype,
         "ds": int(ds),
         "max_batch": int(max_batch),
+        "batch_sizes": [int(s) for s in sizes],
         "bucket_shapes": [list(s) for s in shapes],
         "image_dtypes": sorted(str(np.dtype(dt)) for dt in dtypes),
         "signature_sha": sig_sha,
@@ -179,7 +189,7 @@ class AotBundle:
 
     def check(self, *, sig_sha: str, serve_dtype: str, ds: int,
               max_batch: Optional[int] = None,
-              bucket_shapes=None) -> None:
+              bucket_shapes=None, batch_sizes=None) -> None:
         """Raise ``AotStaleError`` unless the bundle matches the loading
         world on every axis an executable bakes in."""
         import jax
@@ -222,6 +232,22 @@ class AotBundle:
             if missing:
                 raise AotStaleError("bucket_shapes",
                                     f"grid {missing} not in the bundle")
+        if batch_sizes is not None:
+            # the menu is a bake axis: a size the bundle never baked
+            # would compile live on every recovery/scale path — exactly
+            # what the bundle exists to prevent (pre-menu bundles baked
+            # only max_batch and read as {max_batch})
+            baked_sizes = {int(s) for s in
+                           m.get("batch_sizes", (m.get("max_batch"),))
+                           if s is not None}
+            missing_sizes = sorted({int(s) for s in batch_sizes}
+                                   - baked_sizes)
+            if missing_sizes:
+                raise AotStaleError(
+                    "batch_sizes",
+                    f"menu sizes {missing_sizes} not in the bundle "
+                    f"(baked {sorted(baked_sizes)}) — the sub-batch menu "
+                    f"changed since the bake; re-bake with --aot-bake")
 
     def device_ids(self) -> set:
         return {int(p["device_id"]) for p in self.manifest["programs"]}

@@ -6,17 +6,36 @@ shape buckets + masked padding; online serving has the same constraint at
 request granularity, so this batcher reuses the SAME math — the bucket
 mapping is ``data.batching.snap_to_bucket`` and batch assembly is
 ``data.batching.pad_batch`` — it only swaps the epoch schedule for an
-arrival-driven flush policy:
+arrival-driven flush policy.
 
-* a bucket's group flushes the moment it holds ``max_batch`` requests
-  (the batch is full — waiting longer buys nothing);
-* otherwise a group flushes once its OLDEST request has waited
-  ``max_wait_ms`` (bounded latency cost for batching: an idle service adds
-  at most max_wait to any request);
-* every flush pads to exactly ``max_batch`` slots (fill slots are
-  ``sample_mask=0``, precisely the offline dead-slot convention), so each
-  bucket shape is ONE static (B, H, W) signature — the XLA compile count
-  is the distinct-bucket count, independent of traffic.
+Since round 14 the flush policy and launch sizes come from the shared
+scheduling core (``can_tpu/sched``) when a ``ServeSched`` is given:
+
+* a bucket's group flushes the moment it holds the TOP menu size (the
+  batch is full — waiting longer buys nothing);
+* otherwise it flushes at the core's PRICED deadline
+  (``ServeSched.flush_at``): immediately when coalescing one more
+  request cannot beat launch-cost amortization or when the bucket's
+  observed arrival rate says no request is expected inside the window;
+  at the latency cap (``max_wait_ms``) or the group's deadline slack
+  otherwise — with no rate estimate yet the priced deadline IS the old
+  timer, so cold behaviour is unchanged;
+* a flush is covered by the core's menu parts (the planner's exact
+  ``decompose`` DP): a 2-request flush launches a 2-slot program
+  instead of padding to ``max_batch`` (fill slots remain
+  ``sample_mask=0``, the offline dead-slot convention), and every
+  emitted size is a menu size — the XLA compile count is
+  ``buckets x dtypes x menu sizes``, static and warmed up front.
+
+Without a ``sched`` the pre-r14 behaviour is preserved exactly: pad
+every flush to ``max_batch``, flush on the ``max_wait_ms`` timer (the
+bit-compatible baseline the tests and the bench's legacy arm drive).
+
+The pump wakes EXACTLY at the earliest pending flush deadline (or on
+arrival, via the queue's condition) — never on a fixed poll grain: with
+priced deadlines that can be "now", a 50 ms idle poll would have eaten
+the entire low-load latency win, and even under the timer policy a poll
+interval above a short ``max_wait_ms`` silently inflated the tail.
 
 Requests whose deadline expires before dispatch are rejected, never
 launched: a result the client has already given up on still costs a full
@@ -50,6 +69,18 @@ from can_tpu.serve.queue import (
 GroupKey = Tuple[int, int, str]
 
 
+class _Group:
+    """One pending per-key group: requests + the arrival timestamps the
+    priced flush deadline needs."""
+
+    __slots__ = ("requests", "t0", "t_last")
+
+    def __init__(self, t0: float):
+        self.requests: List[ServeRequest] = []
+        self.t0 = t0      # oldest request's submit (latency cap anchor)
+        self.t_last = t0  # newest arrival (the wait-for-next anchor)
+
+
 class MicroBatcher:
     """Pulls from a ``BoundedRequestQueue``, emits padded ``Batch``es.
 
@@ -57,6 +88,10 @@ class MicroBatcher:
     resolves each request (the service wires this to the engine).  A
     dispatch that raises rejects its requests with ``error`` and the
     batcher keeps running: one poison batch must not kill the service.
+
+    sched: optional ``can_tpu.sched.ServeSched`` — the shared scheduling
+    core (priced sub-batch menu + priced flush deadlines).  None keeps
+    the pre-r14 pad-to-``max_batch`` / fixed-timer behaviour exactly.
 
     bucket_ladder / pad_multiple / min_bucket_h: forwarded to
     ``snap_to_bucket`` (same semantics as the offline batcher).
@@ -68,13 +103,19 @@ class MicroBatcher:
                  min_bucket_h: Optional[int] = None, ds: int = 8,
                  telemetry=None, clock=time.monotonic,
                  idle_wait_s: float = 0.05,
-                 on_reject: Optional[Callable] = None):
+                 on_reject: Optional[Callable] = None,
+                 sched=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if sched is not None and sched.max_batch != int(max_batch):
+            raise ValueError(
+                f"sched menu tops out at {sched.max_batch}, batcher "
+                f"max_batch is {max_batch} — one core, one top size")
         self.queue = queue
         self.dispatch = dispatch
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
+        self.sched = sched
         if isinstance(pad_multiple, int):
             pad_multiple = (pad_multiple, pad_multiple)
         self.bucket_ladder = bucket_ladder
@@ -88,8 +129,7 @@ class MicroBatcher:
         self.on_reject = on_reject
         self._clock = clock
         self._idle_wait_s = float(idle_wait_s)
-        # group key -> (requests, oldest enqueue ts)
-        self._pending: Dict[GroupKey, Tuple[List[ServeRequest], float]] = {}
+        self._pending: Dict[GroupKey, _Group] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -99,24 +139,43 @@ class MicroBatcher:
                               pad_multiple=self.pad_multiple,
                               min_bucket_h=self.min_bucket_h)
 
+    # -- flush pricing ---------------------------------------------------
+    def _flush_at(self, key: GroupKey, group: _Group, now: float) -> float:
+        """Absolute flush deadline for one group — the core's priced
+        deadline, or the legacy ``t0 + max_wait`` timer without a core."""
+        if self.sched is None:
+            return group.t0 + self.max_wait_s
+        deadlines = [r.deadline_ts for r in group.requests
+                     if r.deadline_ts is not None]
+        return self.sched.flush_at(key, len(group.requests), group.t0,
+                                   group.t_last, now,
+                                   min(deadlines) if deadlines else None)
+
+    def next_wake_s(self, now: Optional[float] = None) -> float:
+        """Seconds until the earliest pending flush deadline (the EXACT
+        pump wake bound — never a fixed poll grain), or ``idle_wait_s``
+        with nothing pending.  >= 0."""
+        now = self._clock() if now is None else now
+        if not self._pending:
+            return self._idle_wait_s
+        due = min(self._flush_at(k, g, now)
+                  for k, g in self._pending.items())
+        return max(0.0, min(self._idle_wait_s, due - now))
+
     # -- core pump (thread-free, testable with a fake clock) ------------
     def run_once(self, wait_s: Optional[float] = None) -> int:
         """One pump iteration: wait for arrivals (bounded by the earliest
         pending flush deadline), intake, flush what's due.  Returns the
         number of batches dispatched."""
-        wait = self._idle_wait_s if wait_s is None else wait_s
-        if self._pending:
-            due = min(t0 + self.max_wait_s
-                      for _, t0 in self._pending.values())
-            wait = max(0.0, min(wait, due - self._clock()))
+        wait = self.next_wake_s() if wait_s is None else wait_s
         self.queue.wait_nonempty(wait)
         n = self.intake()
         return n + self.poll(self._clock())
 
     def intake(self) -> int:
         """Drain the queue into per-bucket pending groups; reject already
-        expired requests; flush any group that reaches ``max_batch``.
-        Returns batches dispatched."""
+        expired requests; flush any group that reaches the top launch
+        size.  Returns batches dispatched."""
         live, expired = self.queue.drain()
         for r in expired:
             self._reject_expired(r)
@@ -124,23 +183,27 @@ class MicroBatcher:
         for r in live:
             bh, bw = self.bucket_of(r.shape)
             key = (bh, bw, str(r.image.dtype))
-            group, t0 = self._pending.get(key, ([], r.t_submit))
-            group.append(r)
-            self._pending[key] = (group, t0)
-            if len(group) >= self.max_batch:
+            group = self._pending.get(key)
+            if group is None:
+                group = self._pending[key] = _Group(r.t_submit)
+            group.requests.append(r)
+            group.t_last = r.t_submit
+            if self.sched is not None:
+                self.sched.observe_arrival(key, r.t_submit)
+            if len(group.requests) >= self.max_batch:
                 del self._pending[key]
-                self._flush(key, group)
-                flushed += 1
+                flushed += self._flush(key, group.requests)
         return flushed
 
     def poll(self, now: float) -> int:
-        """Reject expired pending requests; flush groups whose oldest
-        request has waited ``max_wait_ms``.  Returns batches dispatched."""
+        """Reject expired pending requests; flush groups whose priced
+        deadline (or legacy timer) has arrived.  Returns batches
+        dispatched."""
         flushed = 0
         for key in sorted(self._pending):
-            group, t0 = self._pending[key]
+            group = self._pending[key]
             kept = []
-            for r in group:
+            for r in group.requests:
                 if r.expired(now):
                     self._reject_expired(r)
                 else:
@@ -148,12 +211,10 @@ class MicroBatcher:
             if not kept:
                 del self._pending[key]
                 continue
-            if now - t0 >= self.max_wait_s:
+            group.requests = kept
+            if now >= self._flush_at(key, group, now):
                 del self._pending[key]
-                self._flush(key, kept)
-                flushed += 1
-            elif len(kept) != len(group):
-                self._pending[key] = (kept, t0)
+                flushed += self._flush(key, kept)
         return flushed
 
     def flush_all(self) -> int:
@@ -161,16 +222,38 @@ class MicroBatcher:
         resolves even when the service is closing)."""
         n = 0
         for key in sorted(self._pending):
-            group, _ = self._pending.pop(key)
-            self._flush(key, group)
-            n += 1
+            group = self._pending.pop(key)
+            n += self._flush(key, group.requests)
         return n
 
     def pending_count(self) -> int:
-        return sum(len(g) for g, _ in self._pending.values())
+        return sum(len(g.requests) for g in self._pending.values())
 
     # -- assembly + dispatch --------------------------------------------
-    def _flush(self, key: GroupKey, group: List[ServeRequest]) -> None:
+    def _flush(self, key: GroupKey, group: List[ServeRequest]) -> int:
+        """Cover the group with menu-size launches (one launch padded to
+        ``max_batch`` without a core) and dispatch each.  Returns the
+        number of batches dispatched."""
+        if self.sched is None:
+            # one padded launch per max_batch-full slice (legacy; a group
+            # never exceeds max_batch in practice — intake flushes full)
+            parts: Tuple[int, ...] = (self.max_batch,) * max(
+                1, -(-len(group) // self.max_batch))
+        else:
+            parts = self.sched.parts_for(len(group))
+        n = 0
+        pos = 0
+        for size in parts:
+            take = group[pos:pos + size]
+            pos += size
+            if not take:
+                break
+            self._flush_part(key, take, size)
+            n += 1
+        return n
+
+    def _flush_part(self, key: GroupKey, group: List[ServeRequest],
+                    size: int) -> None:
         bh, bw = key[0], key[1]
         try:
             # assembly window stamped on every request (service clock):
@@ -185,7 +268,7 @@ class MicroBatcher:
                       np.zeros((r.shape[0] // self.ds,
                                 r.shape[1] // self.ds, 1), np.float32))
                      for r in group]
-            batch = pad_batch(items, (bh, bw), self.max_batch,
+            batch = pad_batch(items, (bh, bw), size,
                               [True] * len(group), self.ds)
             t_ready = self._clock()
             for r in group:

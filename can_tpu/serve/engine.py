@@ -283,28 +283,38 @@ class ServeEngine:
         return pickle.dumps((ser, in_tree, out_tree)), meta
 
     def warmup(self, bucket_shapes, max_batch: int, *,
-               dtypes=(np.float32,)) -> dict:
-        """Compile every (bucket shape, dtype) program before traffic.
+               dtypes=(np.float32,), sizes=None) -> dict:
+        """Compile every (bucket shape, batch size, dtype) program before
+        traffic.
 
         bucket_shapes: iterable of (H, W); dtypes: the image dtypes traffic
-        will carry (float32, and uint8 if the front end admits raw bytes).
-        Returns ``{"shapes": n, "compiles": new, "seconds": wall}``.
+        will carry (float32, and uint8 if the front end admits raw bytes);
+        sizes: the launch-size menu (can_tpu/sched) — every size the
+        batcher may dispatch must be warmed here or a live request pays a
+        mid-traffic compile.  None keeps the single ``max_batch`` program
+        (pre-r14 behaviour).  Returns ``{"shapes": n, "compiles": new,
+        "seconds": wall}``.
         """
+        from can_tpu.sched import normalize_sizes
+
         t0 = time.perf_counter()
         before = self.compile_count
         shapes = sorted(set(map(tuple, bucket_shapes)))
+        sizes = normalize_sizes(max_batch, sizes)
         for bh, bw in shapes:
             if bh % self.ds or bw % self.ds:
                 raise ValueError(f"bucket shape {bh}x{bw} is not a multiple "
                                  f"of the density downsample ({self.ds})")
-            for dt in dtypes:
-                img = np.zeros((bh, bw, 3), dt)
-                dm = np.zeros((bh // self.ds, bw // self.ds, 1), np.float32)
-                batch = pad_batch([(img, dm)], (bh, bw), max_batch,
-                                  [False], self.ds)
-                self.predict_batch(batch)  # np.asarray fetch = fence
+            for size in sizes:
+                for dt in dtypes:
+                    img = np.zeros((bh, bw, 3), dt)
+                    dm = np.zeros((bh // self.ds, bw // self.ds, 1),
+                                  np.float32)
+                    batch = pad_batch([(img, dm)], (bh, bw), size,
+                                      [False], self.ds)
+                    self.predict_batch(batch)  # np.asarray fetch = fence
         dt_s = time.perf_counter() - t0
-        report = {"shapes": len(shapes),
+        report = {"shapes": len(shapes), "sizes": len(sizes),
                   "compiles": self.compile_count - before,
                   "seconds": round(dt_s, 3)}
         self.telemetry.emit("serve.warmup", **report)

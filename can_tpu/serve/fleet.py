@@ -126,13 +126,25 @@ def priced_deadline_s(ledger, name_prefix: str, shape, *,
 
 
 class _WorkItem:
-    __slots__ = ("bucket_hw", "batch", "requests", "redispatches")
+    __slots__ = ("bucket_hw", "batch", "requests", "redispatches",
+                 "t_enqueue", "seq", "cost_px", "min_deadline")
 
-    def __init__(self, bucket_hw, batch, requests):
+    def __init__(self, bucket_hw, batch, requests, *,
+                 t_enqueue: float = 0.0, seq: int = 0):
         self.bucket_hw = bucket_hw
         self.batch = batch
         self.requests = requests
         self.redispatches = 0
+        # priced-dispatch facts (sched.pick_work): enqueue time + seq for
+        # the age/tie rules, model cost (area * slots) for cheapest-first,
+        # earliest live deadline for the urgency class
+        self.t_enqueue = t_enqueue
+        self.seq = seq
+        self.cost_px = (float(bucket_hw[0] * bucket_hw[1])
+                        * batch.image.shape[0])
+        deadlines = [r.deadline_ts for r in requests
+                     if r.deadline_ts is not None]
+        self.min_deadline = min(deadlines) if deadlines else None
 
 
 class ReplicaState:
@@ -219,7 +231,13 @@ class FleetEngine:
                  page_after_probes: int = 3,
                  watchdog_slack: float = 10.0,
                  watchdog_floor_s: float = 1.0,
-                 watchdog_default_s: float = 30.0):
+                 watchdog_default_s: float = 30.0,
+                 dispatch_order: str = "priced",
+                 starvation_age_s: float = 2.0,
+                 deadline_pressure_s: float = 0.5):
+        if dispatch_order not in ("priced", "fifo"):
+            raise ValueError(f"unknown dispatch_order {dispatch_order!r} "
+                             f"(priced | fifo)")
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         devices = list(devices if devices is not None else jax.devices())
@@ -264,6 +282,13 @@ class FleetEngine:
         self.watchdog_compile_s = 900.0
         # jitter is seeded per fleet: chaos tests reproduce bit-exactly
         self._rng = random.Random(0xC0FFEE)
+        # shared-queue dispatch ordering (can_tpu/sched.pick_work):
+        # "priced" = cheapest-feasible-first under deadline pressure with
+        # the starvation age bound; "fifo" = the pre-r14 pure FIFO
+        self.dispatch_order = dispatch_order
+        self.starvation_age_s = float(starvation_age_s)
+        self.deadline_pressure_s = float(deadline_pressure_s)
+        self._work_seq = 0
 
         qparams = quantize_tree(params, serve_dtype)
         # the CURRENT generation's quantized tree, HOST-side: what
@@ -354,30 +379,40 @@ class FleetEngine:
         return sum(1 for r in self.replicas if r.state == REPLICA_ACTIVE)
 
     def warmup(self, bucket_shapes, max_batch: int, *,
-               dtypes=(np.float32,)) -> dict:
-        """Warm EVERY replica's full (bucket, dtype) program grid — the
-        per-replica jit caches are independent, so each pays its own
-        compiles here and none during traffic.  The spec is remembered:
-        rollout's staging warmup re-runs exactly this grid."""
+               dtypes=(np.float32,), sizes=None) -> dict:
+        """Warm EVERY replica's full (bucket, size, dtype) program grid —
+        the per-replica jit caches are independent, so each pays its own
+        compiles here and none during traffic.  ``sizes`` is the
+        scheduling core's launch-size menu (None = just ``max_batch``,
+        pre-r14).  The spec is remembered: rollout's staging warmup,
+        probation, scale-up, and the AOT bake all re-run exactly this
+        grid."""
+        from can_tpu.sched import normalize_sizes
+
+        sizes = normalize_sizes(max_batch, sizes)
         # can-tpu-lint: disable=LOCKHELD(warmup precedes traffic; rollout reads this under _rollout_lock afterwards)
         self._warmup_spec = (sorted(set(map(tuple, bucket_shapes))),
-                             int(max_batch), tuple(dtypes))
+                             int(max_batch), tuple(dtypes), sizes)
         if self._aot is not None:
             # the bundle must cover THIS grid at THIS batch geometry —
-            # a silent partial hit would hide live compiles behind "AOT"
+            # a silent partial hit would hide live compiles behind "AOT";
+            # the menu is a first-class bake axis (a size the bundle
+            # never baked would compile live on every recovery path)
             self._aot.check(sig_sha=self._sig_sha,
                             serve_dtype=self.serve_dtype, ds=self.ds,
                             max_batch=max_batch,
-                            bucket_shapes=self._warmup_spec[0])
+                            bucket_shapes=self._warmup_spec[0],
+                            batch_sizes=sizes)
         t0 = time.perf_counter()
         shapes = compiles = 0
         for r in self.replicas:
             with r.lock:
                 rep = r.engine.warmup(bucket_shapes, max_batch,
-                                      dtypes=dtypes)
+                                      dtypes=dtypes, sizes=sizes)
             shapes = rep["shapes"]
             compiles += rep["compiles"]
-        return {"shapes": shapes, "compiles": compiles,
+        return {"shapes": shapes, "sizes": len(sizes),
+                "compiles": compiles,
                 "replicas": len(self.replicas),
                 "seconds": round(time.perf_counter() - t0, 3)}
 
@@ -441,8 +476,10 @@ class FleetEngine:
     def submit_work(self, bucket_hw, batch, requests) -> None:
         """Called by the service's dispatch (the batcher thread): enqueue
         one assembled micro-batch for whichever replica frees up first."""
-        item = _WorkItem(bucket_hw, batch, requests)
         with self._cond:
+            item = _WorkItem(bucket_hw, batch, requests,
+                             t_enqueue=self._clock(), seq=self._work_seq)
+            self._work_seq += 1
             if not self._closed and self.live_replicas() > 0:
                 self._queue.append(item)
                 self._cond.notify()
@@ -451,13 +488,30 @@ class FleetEngine:
         self._fail(item, FleetClosedError(
             "fleet closed" if closed else "no live replicas"))
 
+    def _pop_next_locked(self) -> _WorkItem:
+        """Next work item under ``_cond``: the scheduling core's priced
+        order (urgent deadline-pressured work EDF-first, the rest
+        cheapest-first, age-promoted against starvation) — or plain FIFO
+        when configured.  A redispatched batch sits at the queue FRONT
+        and is also urgent-class, so both orders serve it first."""
+        if self.dispatch_order == "fifo" or len(self._queue) == 1:
+            return self._queue.popleft()
+        from can_tpu.sched import pick_work
+
+        i = pick_work(self._queue, self._clock(),
+                      starvation_age_s=self.starvation_age_s,
+                      pressure_s=self.deadline_pressure_s)
+        item = self._queue[i]
+        del self._queue[i]
+        return item
+
     def _take(self, replica: ReplicaState) -> Optional[_WorkItem]:
         with self._cond:
             while True:
                 if replica.state != REPLICA_ACTIVE:
                     return None
                 if self._queue:
-                    return self._queue.popleft()
+                    return self._pop_next_locked()
                 if self._closed:
                     return None
                 self._cond.wait(0.1)
@@ -793,7 +847,7 @@ class FleetEngine:
         (a rollout that landed mid-probe makes the staged weights stale
         — re-probe promptly rather than serve them)."""
         gen = self.generation
-        shapes, max_batch, dtypes = self._warmup_spec
+        shapes, max_batch, dtypes, sizes = self._warmup_spec
         t0 = time.perf_counter()
         try:
             engine = self._build_replica_engine(replica.index,
@@ -807,7 +861,8 @@ class FleetEngine:
             dm = np.zeros((bh // self.ds, bw // self.ds, 1), np.float32)
             engine.predict_batch(pad_batch([(img, dm)], (bh, bw),
                                            max_batch, [False], self.ds))
-            rep = engine.warmup(shapes, max_batch, dtypes=dtypes)
+            rep = engine.warmup(shapes, max_batch, dtypes=dtypes,
+                                sizes=sizes)
         except Exception as e:  # noqa: BLE001 — probe failure is data
             with self._cond:
                 if replica.probe_token != token:
@@ -902,13 +957,14 @@ class FleetEngine:
                     f"the fleet was built with")
             dev = spare[0]
             t0 = time.perf_counter()
-            shapes, max_batch, dtypes = self._warmup_spec
+            shapes, max_batch, dtypes, sizes = self._warmup_spec
             with self._cond:
                 index = self._next_index
                 self._next_index = index + 1
             gen = self.generation
             engine = self._build_replica_engine(index, dev)
-            rep = engine.warmup(shapes, max_batch, dtypes=dtypes)
+            rep = engine.warmup(shapes, max_batch, dtypes=dtypes,
+                                sizes=sizes)
             with self._rollout_lock:
                 if self._closed:
                     raise FleetClosedError("fleet closed during scale-up")
@@ -984,7 +1040,7 @@ class FleetEngine:
             if self._warmup_spec is None:
                 raise RuntimeError("bake_aot before warmup(): no "
                                    "(bucket, dtype) grid to bake")
-            shapes, max_batch, dtypes = self._warmup_spec
+            shapes, max_batch, dtypes, sizes = self._warmup_spec
             devices = (list(devices) if devices is not None
                        else list(self._devices_all))
             by_dev = {r.device: r.engine for r in self.replicas
@@ -1005,7 +1061,8 @@ class FleetEngine:
                 out_dir, engines=engines, bucket_shapes=shapes,
                 max_batch=max_batch, dtypes=dtypes, ds=self.ds,
                 serve_dtype=self.serve_dtype, sig_sha=self._sig_sha,
-                generation=self.generation, telemetry=self.telemetry)
+                generation=self.generation, telemetry=self.telemetry,
+                batch_sizes=sizes)
 
     def load_aot(self, bundle) -> None:
         """Attach a bundle (path or ``AotBundle``) for the recovery and
@@ -1093,7 +1150,7 @@ class FleetEngine:
             #    (bucket, dtype) program runs the NEW weights end-to-end
             #    on the staging device before any live replica flips —
             #    catches NaN checkpoints and numeric blowups off-path
-            shapes, max_batch, dtypes = self._warmup_spec
+            shapes, max_batch, dtypes, sizes = self._warmup_spec
             t_stage0 = time.perf_counter()
             staging = ServeEngine(
                 _per_device(rep_params, stage_dev),
@@ -1103,7 +1160,8 @@ class FleetEngine:
                 compute_dtype=self._compute_dtype, ds=self.ds,
                 device=stage_dev, quantized=True, telemetry=self.telemetry,
                 name=f"{self.name}_staging_g{gen}")
-            stage_report = staging.warmup(shapes, max_batch, dtypes=dtypes)
+            stage_report = staging.warmup(shapes, max_batch, dtypes=dtypes,
+                                          sizes=sizes)
             t_stage1 = time.perf_counter()
             if spans is not None:
                 spans.emit(trace_id=trace_id, name="rollout.staging",

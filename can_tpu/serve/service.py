@@ -129,8 +129,28 @@ class CountService:
                  bucket_ladder=None, pad_multiple=None,
                  min_bucket_h: Optional[int] = None,
                  telemetry=None, clock=time.monotonic,
-                 perf_summary_every: int = 32):
+                 perf_summary_every: int = 32,
+                 menu_budget: Optional[int] = None,
+                 flush_policy: str = "priced"):
+        if flush_policy not in ("priced", "timer"):
+            raise ValueError(f"unknown flush_policy {flush_policy!r} "
+                             f"(priced | timer)")
         self.engine = engine
+        # the scheduling core (can_tpu/sched): priced sub-batch menu +
+        # priced flush deadlines.  menu_budget=1 keeps the single
+        # max_batch-slot program; menu_budget=1 AND flush_policy="timer"
+        # is the bit-compatible pre-r14 service (sched=None entirely).
+        from can_tpu.sched import DEFAULT_MENU_BUDGET, ServeSched
+
+        budget = DEFAULT_MENU_BUDGET if menu_budget is None \
+            else int(menu_budget)
+        if budget == 1 and flush_policy == "timer":
+            self.sched = None
+        else:
+            self.sched = ServeSched(int(max_batch),
+                                    max_wait_s=float(max_wait_ms) / 1e3,
+                                    menu_budget=budget,
+                                    priced_flush=flush_policy == "priced")
         # fleet mode: dispatch enqueues instead of executing inline, and
         # replica workers call _complete/_fail_batch back on this service
         self._fleet = engine if hasattr(engine, "submit_work") else None
@@ -157,7 +177,8 @@ class CountService:
                                     min_bucket_h=min_bucket_h,
                                     ds=engine.ds, telemetry=self.telemetry,
                                     clock=clock,
-                                    on_reject=self._note_reject)
+                                    on_reject=self._note_reject,
+                                    sched=self.sched)
         # request latency reservoir: p50/p95/max over recent requests,
         # tagged by bucket shape (skip_first=0 — warmup() already keeps
         # compiles off the request path, so every sample is steady-state).
@@ -190,8 +211,12 @@ class CountService:
     # -- lifecycle -------------------------------------------------------
     def warmup(self, bucket_shapes: Sequence[Tuple[int, int]],
                dtypes=(np.float32,)) -> dict:
-        report = self.engine.warmup(bucket_shapes, self.max_batch,
-                                    dtypes=dtypes)
+        # the menu rides the warmup: every size the core may dispatch is
+        # compiled here, so traffic never mints a program (the zero-new-
+        # compiles pin holds per menu size, not just per bucket)
+        report = self.engine.warmup(
+            bucket_shapes, self.max_batch, dtypes=dtypes,
+            sizes=self.sched.menu if self.sched is not None else None)
         self.warmed_dtypes.update(np.dtype(dt) for dt in dtypes)
         ledger = getattr(self.telemetry, "ledger", None)
         if ledger is not None:
@@ -442,9 +467,29 @@ class CountService:
                 rs["batches"] += 1
                 rs["completed"] += len(requests)
         extra = {} if replica is None else {"replica": replica}
+        # scheduler economics on every flush: dead slots, fill %, and the
+        # core's predicted vs realized launch cost (pixel units, the
+        # offline planner's).  predicted is recomputed INDEPENDENTLY from
+        # the valid count (ServeSched.cover_one) — the batcher chose the
+        # size through the same core, so any divergence is a scheduling
+        # bug the can_tpu_sched_* gauges must surface, not noise.  The
+        # legacy no-core service predicts its own contract: every launch
+        # pads to max_batch.
+        slots = batch.image.shape[0]
+        area = float(bucket_hw[0] * bucket_hw[1])
+        if self.sched is not None:
+            predicted = self.sched.predicted_cost_px(area, len(requests))
+            realized = self.sched.realized_cost_px(area, slots)
+        else:
+            predicted = area * self.max_batch
+            realized = area * slots
         self.telemetry.emit("serve.batch", bucket=list(bucket_hw),
-                           size=batch.image.shape[0], valid=len(requests),
+                           size=slots, valid=len(requests),
                            fill=round(fill, 4),
+                           fill_pct=round(100.0 * fill, 2),
+                           padded_slots=slots - len(requests),
+                           predicted_cost_px=round(predicted, 1),
+                           realized_cost_px=round(realized, 1),
                            execute_s=round(execute_s, 6),
                            compiled=compiled,
                            queue_depth=self.queue.depth(), **extra)

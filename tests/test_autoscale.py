@@ -102,7 +102,9 @@ class TestAotBundle:
         fleet, svc = make_fleet_service(params, telemetry=tel)
         d = str(tmp_path / "aot")
         manifest = fleet.bake_aot(d, devices=jax.devices()[:3])
-        assert len(manifest["programs"]) == 3  # 1 bucket x 3 devices
+        # 1 bucket x menu sizes x 3 devices (the r14 sub-batch menu is
+        # a bake axis: every size the batcher may dispatch is baked)
+        assert len(manifest["programs"]) == 3 * len(svc.sched.menu)
         assert manifest["signature_sha"] == fleet._sig_sha
 
         tel2 = obs.Telemetry()
@@ -459,8 +461,10 @@ class TestResurrection:
 
         h_, w_ = img.shape[:2]
         dm = np.zeros((h_ // 8, w_ // 8, 1), np.float32)
+        # a lone request launches the 1-slot MENU program (r14): the
+        # bit-for-bit oracle must run the same program shape
         want, _ = ref.predict_batch(
-            pad_batch([(img, dm)], (64, 64), 2, [True], 8))
+            pad_batch([(img, dm)], (64, 64), 1, [True], 8))
         assert got == float(want[0])
 
     def test_resurrection_with_aot_is_zero_compile(self, params,

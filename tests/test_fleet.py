@@ -416,8 +416,10 @@ class TestRollout:
         from can_tpu.data.batching import pad_batch
 
         dm = np.zeros((8, 8, 1), np.float32)
+        # a lone request launches the 1-slot MENU program (r14): the
+        # bit-for-bit oracle must run the same program shape
         want, _ = oracle.predict_batch(
-            pad_batch([(img, dm)], (64, 64), 2, [True], 8))
+            pad_batch([(img, dm)], (64, 64), 1, [True], 8))
         assert after == float(want[0])
         assert after != before  # it actually changed weights
 

@@ -330,9 +330,10 @@ class TestEngineAndService:
                            queue_capacity=256,
                            bucket_ladder=ladder)
         rep = svc.warmup([(h, w) for h in ladder[0] for w in ladder[1]])
-        # compile bound: one program per distinct bucket shape (engine is
-        # module-scoped, so compare this warmup's DELTA, not the total)
-        assert rep["compiles"] <= 4
+        # compile bound: one program per (bucket shape, menu size) — the
+        # r14 sub-batch menu rides the warmup (engine is module-scoped,
+        # so compare this warmup's DELTA, not the total)
+        assert rep["compiles"] <= 4 * len(svc.sched.menu)
         compiles_before_traffic = small_engine.compile_count
         sizes = [(64, 64), (96, 96), (64, 96), (96, 64), (60, 60), (90, 90)]
         rng = np.random.default_rng(0)
@@ -645,15 +646,20 @@ class TestServeSpansAndPerf:
         from can_tpu.train.steps import batch_signature
 
         from can_tpu.data.batching import pad_batch
-        warm = pad_batch([(np.zeros((64, 64, 3), np.float32),
-                           np.zeros((8, 8, 1), np.float32))],
-                         (64, 64), 2, [False], 8)
-        tel.ledger.register(
-            "serve_predict",
-            batch_signature({"image": warm.image, "dmap": warm.dmap,
-                             "pixel_mask": warm.pixel_mask,
-                             "sample_mask": warm.sample_mask}),
-            cost=(1e9, 1e8))
+
+        # one registration per MENU size (the r14 sub-batch menu): a
+        # flush may launch any menu-size program, and a fresh CLI's
+        # warmup registers them all
+        for size in svc.sched.menu:
+            warm = pad_batch([(np.zeros((64, 64, 3), np.float32),
+                               np.zeros((8, 8, 1), np.float32))],
+                             (64, 64), size, [False], 8)
+            tel.ledger.register(
+                "serve_predict",
+                batch_signature({"image": warm.image, "dmap": warm.dmap,
+                                 "pixel_mask": warm.pixel_mask,
+                                 "sample_mask": warm.sample_mask}),
+                cost=(1e9, 1e8))
         with svc:
             tickets = [svc.submit(np.zeros((64, 64, 3), np.float32),
                                   deadline_ms=60_000) for _ in range(4)]

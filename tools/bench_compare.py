@@ -51,13 +51,17 @@ def load_suite(path: str) -> dict:
 
 
 def _direction(unit: str) -> int:
-    """+1 when bigger is better (rates), -1 when smaller is (durations,
-    and compiled-program costs: the perf-ledger tier's gflops, where
-    creeping UP means a model/XLA change bloated the program; the bn
-    tier's gbytes, where creeping UP means a moments path lost a fusion
-    — shrinking bytes IS the improvement, so gbytes stays one-sided),
-    0 unknown (never gates)."""
+    """+1 when bigger is better (rates, and the sched tier's fill_pct:
+    batch fill dropping means the scheduler is burning dead slots again
+    — gated DOWNWARD only, fill growing is the improvement), -1 when
+    smaller is (durations, and compiled-program costs: the perf-ledger
+    tier's gflops, where creeping UP means a model/XLA change bloated
+    the program; the bn tier's gbytes, where creeping UP means a moments
+    path lost a fusion — shrinking bytes IS the improvement, so gbytes
+    stays one-sided), 0 unknown (never gates)."""
     u = (unit or "").lower()
+    if u == "fill_pct":
+        return +1
     if "/sec" in u or "/s" in u:
         return +1
     if u in ("seconds", "s", "ms", "gflops", "gbytes"):

@@ -32,6 +32,14 @@
 #       scale-up (unit ms, fixed offered rate).  Forces cpu8 like the
 #       fleet tier (the scale-up needs a spare device).
 #
+#   CI_BENCH_ONLY=sched tools/ci_bench_gate.sh BENCH_SCHED_cpu_r14.json
+#       gates the scheduling-core tier (can_tpu/sched): serve batch fill
+#       at low and mixed load (unit fill_pct, gated DOWNWARD only — fill
+#       dropping means dead slots are back), p99 + time-to-flush p95
+#       (ms, upward) and mixed-load throughput (req/s, downward),
+#       through the priced menu + priced-flush service on ONE device
+#       (no cpu8 needed)
+#
 #   CI_BENCH_ONLY=slo tools/ci_bench_gate.sh
 #       gates the SLO layer: tools/slo_report.py grades the committed
 #       fleet-bench-era telemetry fixture (SLO_FIXTURE_cpu_r12.jsonl)
@@ -121,11 +129,16 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # trap — the autoscale tier's artifact defaults to the committed
     # BENCH_AUTOSCALE_cpu_r13.json exactly when BENCH_SUITE_ONLY=
     # autoscale, which is how this gate runs it.
+    # BENCH_SCHED_OUT: fifth instance of the baseline-overwrite trap —
+    # the sched tier's artifact defaults to the committed
+    # BENCH_SCHED_cpu_r14.json exactly when BENCH_SUITE_ONLY=sched,
+    # which is how this gate runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
         BENCH_FLEET_OUT="${BENCH_FLEET_OUT:-${OUT}.fleet.json}" \
         BENCH_AUTOSCALE_OUT="${BENCH_AUTOSCALE_OUT:-${OUT}.autoscale.json}" \
+        BENCH_SCHED_OUT="${BENCH_SCHED_OUT:-${OUT}.sched.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi
