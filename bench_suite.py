@@ -1126,6 +1126,242 @@ class _SchedMismatchSink:
         pass
 
 
+def _run_stream_load(service, images, *, n_streams, frames, rate_rps,
+                     deadline_ms, seed, use_streams=True, seqs=None):
+    """Open-loop stream driver: ``n_streams`` synthetic cameras sending
+    ``frames`` frames each at an aggregate Poisson ``rate_rps``, with
+    monotonic per-stream frame_seq (``use_streams=False`` is the legacy
+    no-session arm: the SAME traffic as stateless requests).  Consults
+    the fault injector's stream grammar (``stream_burst`` rate spikes,
+    ``frame_gap`` dup/out-of-order delivery) per frame, like the chaos
+    test's driver.  Returns fresh/degraded latencies, stalenesses, and
+    rejects by reason."""
+    from can_tpu.serve import RejectedError
+    from can_tpu.testing.faults import active_injector
+
+    rng = np.random.default_rng(seed)
+    seqs = seqs if seqs is not None else {k: 0 for k in range(n_streams)}
+    tickets = []
+
+    def submit(k, seq_override=None):
+        sid = f"cam{k}"
+        if not use_streams:
+            tickets.append(service.submit(images[k % len(images)],
+                                          deadline_ms=deadline_ms))
+            return
+        if seq_override is None:
+            seqs[k] += 1
+            fs = seqs[k]
+        else:
+            fs = seq_override
+        tickets.append(service.submit(images[k % len(images)],
+                                      deadline_ms=deadline_ms,
+                                      stream_id=sid, frame_seq=fs))
+
+    t0 = time.perf_counter()
+    next_t = 0.0
+    for f in range(frames):
+        for k in range(n_streams):
+            next_t += float(rng.exponential(1.0 / rate_rps))
+            sleep = t0 + next_t - time.perf_counter()
+            if sleep > 0:
+                time.sleep(sleep)
+            inj = active_injector()
+            if inj is not None:
+                d = inj.on_stream_frame(stream=f"cam{k}", frame=f + 1)
+                if d is not None and d["kind"] == "stream_burst":
+                    for _ in range(d["burst"]):
+                        submit(k)
+                elif d is not None:  # frame_gap
+                    submit(k, seq_override=(seqs[k] if d["mode"] == "dup"
+                                            else max(seqs[k] - 2, 0)))
+            submit(k)
+    fresh, degraded, staleness = [], [], []
+    rejects = {}
+    for t in tickets:
+        try:
+            res = t.result(timeout=120.0)
+            if getattr(res, "degraded", False):
+                degraded.append(res.latency_s)
+                if res.staleness_s is not None:
+                    staleness.append(res.staleness_s)
+            else:
+                fresh.append(res.latency_s)
+        except RejectedError as e:
+            rejects[e.reason] = rejects.get(e.reason, 0) + 1
+    wall = time.perf_counter() - t0
+    return {"submitted": len(tickets), "fresh": fresh,
+            "degraded": degraded, "staleness": staleness,
+            "rejects": rejects, "wall_s": wall,
+            "served_rps": (len(fresh) + len(degraded)) / max(wall, 1e-9)}
+
+
+def bench_stream(*, n_streams=4, frames=8, repeats=3, max_batch=4,
+                 out_path=None) -> list:
+    """Streaming-session tier (r15): sustained per-stream p99 and
+    streams-per-device at a fixed deadline, and the degradation ladder
+    under 2x overload — with the legacy (no-session) arm driven by the
+    SAME traffic in the SAME run.  The committed artifact is the
+    receipt that the ladder ENGAGES under overload (degraded fraction
+    > 0 where the legacy arm can only reject) and that degraded answers
+    are CHEAP (their p99 is the EWMA-lookup cost, not a launch).
+
+    Phases per arm: capacity probe (a back-to-back burst measures the
+    box's served rate — "2x overload" means 2x THAT, not 2x an
+    arbitrary offered rate), sustained at ``BENCH_STREAM_RATE`` (default
+    4 req/s aggregate, below capacity), then overload at 2x measured
+    capacity.  Gated records: ``serve_stream_p99_sustained`` (ms,
+    upward), ``serve_stream_rps_sustained`` (req/s, downward),
+    ``serve_stream_streams_per_device`` (unit ``streams``, downward-
+    gated — how many fixed-rate cameras one device sustains inside the
+    deadline), ``serve_stream_degraded_p99_2x`` (ms, upward: degraded
+    answers must stay cheap) and ``serve_stream_fresh_p99_2x`` (ms,
+    upward).  ``serve_stream_degraded_frac_2x`` (unit ``frac``) rides
+    ungated as the ladder-engagement receipt, with the legacy arm's
+    reject fraction as context."""
+    import statistics
+
+    import jax
+
+    from can_tpu.models import cannet_init
+    from can_tpu.obs import Telemetry
+    from can_tpu.serve import CountService, ServeEngine, prepare_image
+
+    rate = float(os.environ.get("BENCH_STREAM_RATE", "4"))
+    deadline_ms = float(os.environ.get("BENCH_STREAM_DEADLINE_MS", "2000"))
+    params = cannet_init(jax.random.key(0))
+    sizes = [(64, 64)]
+    ladder = ((64,), (64,))
+    rng = np.random.default_rng(7)
+    images = [prepare_image(
+        (rng.uniform(0, 1, (h, w, 3)) * 255).astype(np.uint8))
+        for h, w in sizes]
+
+    def run_arm(tag, use_streams):
+        tel = Telemetry()
+        engine = ServeEngine(params, telemetry=tel, name=f"stream_{tag}")
+        svc = CountService(engine, max_batch=max_batch, max_wait_ms=5.0,
+                           queue_capacity=64, bucket_ladder=ladder,
+                           telemetry=tel,
+                           degrade_policy="priced" if use_streams
+                           else "off")
+        svc.warmup(sizes)
+        out = {"sustained": [], "overload": []}
+        with svc:
+            # capacity probe: a burst of stateless requests back to
+            # back — the served rate the overload phase doubles
+            burst = [svc.submit(images[0], deadline_ms=30_000)
+                     for _ in range(4 * max_batch)]
+            t0 = time.perf_counter()
+            for t in burst:
+                t.result(timeout=120.0)
+            cap_rps = len(burst) / max(time.perf_counter() - t0, 1e-9)
+            out["capacity_rps"] = round(cap_rps, 2)
+            seqs = {k: 0 for k in range(n_streams)}
+            for rep in range(repeats):
+                out["sustained"].append(_run_stream_load(
+                    svc, images, n_streams=n_streams, frames=frames,
+                    rate_rps=rate, deadline_ms=deadline_ms, seed=rep,
+                    use_streams=use_streams, seqs=seqs))
+                # overload runs LONGER than sustained (4x the frames):
+                # the ladder triggers on accumulated backlog, and a
+                # fraction-of-a-second burst would end before the
+                # per-stream outstanding ever crossed its allowance
+                out["overload"].append(_run_stream_load(
+                    svc, images, n_streams=n_streams, frames=4 * frames,
+                    rate_rps=2.0 * cap_rps, deadline_ms=deadline_ms,
+                    seed=100 + rep, use_streams=use_streams, seqs=seqs))
+            out["stream_stats"] = svc.stats()["streams"]
+        return out
+
+    stream_arm = run_arm("sessions", True)
+    legacy_arm = run_arm("legacy", False)
+
+    med = statistics.median
+    p99 = lambda xs: (  # noqa: E731
+        float(np.percentile(np.asarray(xs, np.float64) * 1e3, 99))
+        if xs else None)
+    spread = lambda xs: round(  # noqa: E731
+        100.0 * (max(xs) - min(xs)) / max(abs(med(xs)), 1e-9), 1)
+
+    sus_p99 = [p99(r["fresh"]) for r in stream_arm["sustained"]]
+    sus_rps = [r["served_rps"] for r in stream_arm["sustained"]]
+    # streams-per-device at the fixed deadline: how many cameras at
+    # this per-stream frame rate one device absorbs while serving
+    # inside the deadline — served rate over the per-stream offered rate
+    per_stream_rate = rate / n_streams
+    spd = [r["served_rps"] / per_stream_rate
+           for r in stream_arm["sustained"]]
+    ov_fresh_p99 = [p99(r["fresh"]) for r in stream_arm["overload"]]
+    ov_deg_p99 = [p99(r["degraded"]) for r in stream_arm["overload"]
+                  if r["degraded"]]
+    deg_frac = [len(r["degraded"]) / max(r["submitted"], 1)
+                for r in stream_arm["overload"]]
+    leg_sus_p99 = [p99(r["fresh"]) for r in legacy_arm["sustained"]]
+    leg_rej_frac = [sum(r["rejects"].values()) / max(r["submitted"], 1)
+                    for r in legacy_arm["overload"]]
+
+    base = {"n_streams": n_streams, "frames": frames, "repeats": repeats,
+            "max_batch": max_batch, "rate_rps": rate,
+            "deadline_ms": deadline_ms,
+            "capacity_rps": stream_arm["capacity_rps"],
+            "conditions": "single device, 64x64 bucket, capacity-probed "
+                          "2x overload, sessions vs legacy same run"}
+    records = []
+
+    def rec(metric, vals, unit, **extra):
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return
+        records.append({"metric": metric, "value": round(med(vals), 3),
+                        "unit": unit, "spread_pct": spread(vals),
+                        **base, **extra})
+
+    rec("serve_stream_p99_sustained", sus_p99, "ms",
+        legacy_p99_ms=(round(med([x for x in leg_sus_p99
+                                  if x is not None]), 3)
+                       if any(x is not None for x in leg_sus_p99)
+                       else None))
+    rec("serve_stream_rps_sustained", sus_rps, "req/s")
+    rec("serve_stream_streams_per_device", spd, "streams")
+    leg_ov_p99 = [p99(r["fresh"]) for r in legacy_arm["overload"]]
+    rec("serve_stream_fresh_p99_2x", ov_fresh_p99, "ms",
+        legacy_p99_2x_ms=(round(med([x for x in leg_ov_p99
+                                     if x is not None]), 3)
+                          if any(x is not None for x in leg_ov_p99)
+                          else None))
+    rec("serve_stream_degraded_p99_2x", ov_deg_p99, "ms")
+    rec("serve_stream_degraded_frac_2x", deg_frac, "frac",
+        legacy_reject_frac=round(med(leg_rej_frac), 4),
+        stream_stats=stream_arm["stream_stats"])
+    for r in records:
+        if _TELEMETRY is not None:
+            _TELEMETRY.emit("bench", **r)
+        print(json.dumps(r), flush=True)
+
+    out = out_path or os.environ.get("BENCH_STREAM_OUT")
+    if not out:
+        # committed gate baseline only for an explicit stream-only run
+        # (the perf/bn/fleet/autoscale/sched no-self-overwrite rule,
+        # 6th use)
+        out = ("BENCH_STREAM_cpu_r15.json"
+               if os.environ.get("BENCH_SUITE_ONLY") == "stream"
+               else "BENCH_STREAM_local.json")
+    doc = {"metric": "serve_stream",
+           "config": {**base, "platform": jax.devices()[0].platform},
+           "legacy_arm": {
+               "capacity_rps": legacy_arm["capacity_rps"],
+               "overload_reject_frac": round(med(leg_rej_frac), 4),
+               "sustained_p99_ms": [x for x in leg_sus_p99],
+               "overload_p99_ms": [x for x in leg_ov_p99],
+           },
+           "results": records}
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# stream tier: {len(records)} records -> {out}", flush=True)
+    return records
+
+
 def bench_autoscale(*, replicas=2, n_requests=32, repeats=3, max_batch=4,
                     rate_rps=None, out_path=None) -> list:
     """Self-healing/autoscale tier (ISSUE 13): time-to-first-ready for a
@@ -1385,6 +1621,8 @@ def main() -> None:
             bench_autoscale(n_requests=16, repeats=2)
         if want("sched"):
             bench_sched(n_requests=16, repeats=2)
+        if want("stream"):
+            bench_stream(n_streams=2, frames=6, repeats=2)
     else:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=16, h=576, w=768, steps=20)
@@ -1438,6 +1676,10 @@ def main() -> None:
             # scheduling-core tier: single engine, no cpu8 needed
             # (BENCH_SCHED_cpu_r14.json)
             bench_sched()
+        if want("stream"):
+            # streaming-session tier: single engine, capacity-probed 2x
+            # overload, sessions + legacy arms (BENCH_STREAM_cpu_r15.json)
+            bench_stream()
 
     if _TELEMETRY is not None:
         from can_tpu.obs import emit_memory

@@ -154,6 +154,25 @@ def parse_args(argv=None):
     p.add_argument("--watchdog-default-s", type=float, default=30.0,
                    help="hang-watchdog deadline before any timing "
                         "exists (or without a ledger)")
+    # per-stream sessions (serve/streams.py)
+    p.add_argument("--stream-ttl-s", type=float, default=300.0,
+                   help="evict a stream session idle this long (host-"
+                        "side state: count/density EWMA, frame sequence, "
+                        "sticky replica pin — clients opt in per request "
+                        "with ?stream_id=...&frame_seq=N)")
+    p.add_argument("--degrade-policy", type=str, default="priced",
+                   choices=["priced", "off"],
+                   help="priced: the per-stream degradation ladder — "
+                        "full inference -> frame-skip (answer from the "
+                        "session EWMA, labelled degraded+staleness, no "
+                        "launch) -> reject, driven by arrival rate vs "
+                        "the sched core's priced drain cost with "
+                        "hysteresis; off: sessions + sticky routing + "
+                        "sequence hygiene only, never skip a frame")
+    p.add_argument("--max-body-mb", type=float, default=64.0,
+                   help="HTTP 413 any POST body over this many MiB "
+                        "BEFORE reading it (one unbounded multi-GB "
+                        "upload would OOM the serve host)")
     p.add_argument("--u8-warmup", action="store_true",
                    help="also pre-compile uint8-input programs, for "
                         "clients POSTing ?raw=1 (pixels stay bytes on the "
@@ -276,6 +295,12 @@ def build_service(args, telemetry=None):
         # the expected-cost curve is flat and the search is just heat
         raise SystemExit(f"--menu-budget must be in [1, 8], got "
                          f"{args.menu_budget}")
+    if args.stream_ttl_s <= 0:
+        raise SystemExit(f"--stream-ttl-s must be positive, got "
+                         f"{args.stream_ttl_s}")
+    if args.max_body_mb <= 0:
+        raise SystemExit(f"--max-body-mb must be positive, got "
+                         f"{args.max_body_mb}")
     fleet_only = ["--aot-bundle", "--aot-bake", "--autoscale-max"]
     if args.replicas <= 1 and (args.aot_bundle or args.aot_bake
                                or args.autoscale_max):
@@ -331,7 +356,10 @@ def build_service(args, telemetry=None):
                            default_deadline_ms=args.deadline_ms,
                            bucket_ladder=ladder, telemetry=telemetry,
                            menu_budget=args.menu_budget,
-                           flush_policy=args.flush_policy)
+                           flush_policy=args.flush_policy,
+                           stream_ttl_s=args.stream_ttl_s,
+                           degrade_policy=args.degrade_policy,
+                           max_body_mb=args.max_body_mb)
     if args.replicas > 1:
         # the /rollout endpoint's checkpoint loader (fleet only: a single
         # engine has no staging replica to warm on)

@@ -79,12 +79,22 @@ import numpy as np
 # (parallel/elastic.py): one completed shrink-and-continue transition —
 # old/new world (processes, dp), interrupted epoch + step, consumed vs
 # remaining items, and the lr/global-batch rescaling applied.
+# stream.* kinds come from the per-stream session layer
+# (serve/streams.py): stream.session is a session lifecycle mark (open /
+# periodic snapshot / TTL evict, carrying the active-session gauge),
+# stream.degrade is one degradation-ladder RUNG TRANSITION (full ->
+# frame-skip -> reject; individual EWMA-served answers ride
+# serve.request with degraded=true + staleness_s), and stream.repin is
+# one sticky-pin invalidation after a fleet fault (quarantine / wedge /
+# scale-down / resurrection at a new incarnation) with the live replica
+# the stream re-pinned to.
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
                "fleet.replica", "fleet.rollout",
                "fleet.probe", "fleet.resurrect", "fleet.scale",
+               "stream.session", "stream.degrade", "stream.repin",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",
                "perf.summary", "trace.span",

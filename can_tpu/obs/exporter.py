@@ -172,6 +172,31 @@ class GaugeSink:
                 self._count((f"{pre}_health_alerts_total",
                              (("signal", str(p.get("signal", "?"))),
                               ("kind", str(p.get("alert", "?"))))))
+            elif kind == "serve.request":
+                # stream degradation visibility: EWMA-served answers
+                # count (vs the fresh-inference total riding
+                # events_total{kind="serve.request"}) and the last
+                # served staleness — the live view of the ladder's
+                # "degrade instead of drown" contract
+                if p.get("degraded"):
+                    self._count((f"{pre}_stream_degraded_total", ()))
+                    if p.get("staleness_s") is not None:
+                        self._gauges[f"{pre}_stream_staleness_s"] = \
+                            float(p["staleness_s"])
+            elif kind == "stream.session":
+                if p.get("active") is not None:
+                    # sampled exactly when the session set changes or
+                    # snapshots (the serve.batch queue-depth discipline)
+                    self._gauges[f"{pre}_stream_sessions"] = \
+                        float(p["active"])
+                if str(p.get("state")) == "evicted":
+                    self._count((f"{pre}_stream_evictions_total", ()))
+            elif kind == "stream.degrade":
+                # one ladder rung TRANSITION (not one degraded answer)
+                self._count((f"{pre}_stream_degrade_total",
+                             (("rung", str(p.get("rung", "?"))),)))
+            elif kind == "stream.repin":
+                self._count((f"{pre}_stream_repins_total", ()))
             elif kind == "serve.batch":
                 # scheduler economics (can_tpu/sched): per-flush fill %
                 # and dead slots, plus the predicted-vs-realized launch

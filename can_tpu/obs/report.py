@@ -72,6 +72,14 @@ def summarize(events: Iterable[dict]) -> dict:
     serve_slots = 0
     serve_valid = 0
     serve_queue_depth_max = None
+    # stream sessions (serve/streams.py): degraded answers off
+    # serve.request, lifecycle/ladder/pin counts off the stream.* kinds
+    stream_degraded = 0
+    stream_staleness: List[float] = []
+    stream_sessions_last = None
+    stream_evictions = 0
+    stream_degrade_by_rung: dict = {}
+    stream_repins = 0
     # scheduling core (can_tpu/sched): per-flush economics off serve.batch
     sched_padded = 0
     sched_pred_px = 0.0
@@ -140,6 +148,10 @@ def summarize(events: Iterable[dict]) -> dict:
                 serve_queue_wait.append(float(p["queue_wait_s"]))
             if "device_s" in p:
                 serve_device.append(float(p["device_s"]))
+            if p.get("degraded"):
+                stream_degraded += 1
+                if p.get("staleness_s") is not None:
+                    stream_staleness.append(float(p["staleness_s"]))
         elif kind == "serve.batch":
             serve_batches += 1
             serve_slots += int(p.get("size", 0))
@@ -200,6 +212,17 @@ def summarize(events: Iterable[dict]) -> dict:
                 fleet_live_last = int(p["live"])
         elif kind == "fleet.probe":
             fleet_probes["ok" if p.get("ok") else "failed"] += 1
+        elif kind == "stream.session":
+            if p.get("active") is not None:
+                stream_sessions_last = int(p["active"])
+            if p.get("state") == "evicted":
+                stream_evictions += 1
+        elif kind == "stream.degrade":
+            rung = str(p.get("rung", "?"))
+            stream_degrade_by_rung[rung] = \
+                stream_degrade_by_rung.get(rung, 0) + 1
+        elif kind == "stream.repin":
+            stream_repins += 1
         elif kind == "incident.bundle":
             reason = str(p.get("reason", "?"))
             incidents_by_reason[reason] = \
@@ -258,6 +281,14 @@ def summarize(events: Iterable[dict]) -> dict:
         "serve_queue_wait_p50_s": _percentile(serve_queue_wait, 50),
         "serve_queue_wait_p95_s": _percentile(serve_queue_wait, 95),
         "serve_device_p95_s": _percentile(serve_device, 95),
+        # stream sessions (serve/streams.py); zeros/Nones pre-stream
+        "stream_sessions": stream_sessions_last,
+        "stream_degraded": stream_degraded,
+        "stream_staleness_p95_s": _percentile(stream_staleness, 95),
+        "stream_degrade_transitions": dict(
+            sorted(stream_degrade_by_rung.items())),
+        "stream_repins": stream_repins,
+        "stream_evictions": stream_evictions,
         # serving fleet (can_tpu/serve/fleet.py); zeros/empty single-engine
         "fleet_rollouts": fleet_rollouts,
         "fleet_generation": fleet_generation,
@@ -501,6 +532,20 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                  f"realized={_fmt(summary['sched_realized_cost_px'])}px "
                  + ("predicted==realized" if not mism
                     else f"MISMATCHES={mism}")))
+    if (summary.get("stream_sessions") is not None
+            or summary.get("stream_degraded")
+            or summary.get("stream_repins")):
+        by_rung = summary.get("stream_degrade_transitions") or {}
+        rungs = (" transitions: " + " ".join(f"{k}={n}" for k, n
+                                             in by_rung.items())
+                 if by_rung else "")
+        rows.append(
+            ("streams",
+             f"sessions={_fmt(summary.get('stream_sessions'))} "
+             f"degraded={summary['stream_degraded']} "
+             f"staleness p95={_fmt(summary['stream_staleness_p95_s'], ' s')} "
+             f"repins={summary['stream_repins']} "
+             f"evictions={summary['stream_evictions']}" + rungs))
     if (summary.get("fleet_rollouts") or summary.get("fleet_quarantines")
             or summary.get("fleet_replica_states")):
         states = summary.get("fleet_replica_states") or {}

@@ -301,7 +301,8 @@ def normalize_sizes(max_batch: int, sizes=None) -> Tuple[int, ...]:
 
 def pick_work(items: Sequence, now: float, *,
               starvation_age_s: float = 2.0,
-              pressure_s: float = 0.5) -> int:
+              pressure_s: float = 0.5,
+              prefer: Optional[int] = None) -> int:
     """Index of the work item the fleet should run next: cheapest-
     feasible-first under deadline pressure.
 
@@ -318,24 +319,40 @@ def pick_work(items: Sequence, now: float, *,
       slots``): small launches drain fast and keep p50 low while
       nothing is at risk.
 
+    ``prefer`` is the pulling replica's index, for STICKY STREAM
+    ROUTING (serve/streams.py): an item whose ``pin`` matches wins over
+    an unpinned item, which wins over one pinned elsewhere — primary
+    within the relaxed tier (locality is the relaxed tier's whole
+    objective), a trailing tiebreak in the pressured/urgent tiers
+    (correctness first: a deadline or a starvation bound always
+    outranks cache affinity).  Preference, never exclusion — any
+    replica may still take any item, so a pin can never starve a
+    stream behind a dead or busy replica (pinned by
+    tests/test_streams.py).
+
     The age promotion is the starvation bound: a relaxed item bypassed
     by cheaper work becomes urgent after ``starvation_age_s`` and from
     then on only genuinely expiring work jumps it, so no item waits
     more than ``starvation_age_s`` plus the deadline-pressured drain
     (pinned by tests/test_sched.py).  Items must expose ``t_enqueue``,
     ``seq``, ``cost_px``, ``min_deadline`` (None ok),
-    ``redispatches``."""
+    ``redispatches``; ``pin`` (a replica index or None) is optional —
+    absent reads as unpinned, so pre-stream items rank exactly as
+    before."""
     best_i = 0
     best_rank = None
     for i, it in enumerate(items):
+        pin = getattr(it, "pin", None)
+        aff = (1 if pin is None or prefer is None
+               else (0 if pin == prefer else 2))
         dl = getattr(it, "min_deadline", None)
         if dl is not None and dl - now <= pressure_s:
-            rank = (0, dl, it.seq)
+            rank = (0, dl, aff, it.seq)
         elif (getattr(it, "redispatches", 0) > 0
                 or now - it.t_enqueue >= starvation_age_s):
-            rank = (1, it.t_enqueue, it.seq)
+            rank = (1, it.t_enqueue, aff, it.seq)
         else:
-            rank = (2, it.cost_px, it.seq)
+            rank = (2, aff, it.cost_px, it.seq)
         if best_rank is None or rank < best_rank:
             best_rank, best_i = rank, i
     return best_i

@@ -52,6 +52,8 @@ from .queue import (
     REJECT_ERROR,
     REJECT_QUEUE_FULL,
     REJECT_SHUTDOWN,
+    REJECT_STALE_FRAME,
+    REJECT_STREAM_OVERLOAD,
     BoundedRequestQueue,
     RejectedError,
     ServeRequest,
@@ -63,6 +65,14 @@ from .service import (
     make_http_handler,
     prepare_image,
     serve_http,
+)
+from .streams import (
+    STREAM_RUNG_FULL,
+    STREAM_RUNG_REJECT,
+    STREAM_RUNG_SKIP,
+    StreamSession,
+    StreamSessionRegistry,
+    repin_target,
 )
 
 __all__ = [
@@ -93,12 +103,20 @@ __all__ = [
     "REJECT_ERROR",
     "REJECT_QUEUE_FULL",
     "REJECT_SHUTDOWN",
+    "REJECT_STALE_FRAME",
+    "REJECT_STREAM_OVERLOAD",
     "RejectedError",
+    "STREAM_RUNG_FULL",
+    "STREAM_RUNG_REJECT",
+    "STREAM_RUNG_SKIP",
     "ServeEngine",
     "ServeRequest",
     "ServeResult",
     "ServeTicket",
+    "StreamSession",
+    "StreamSessionRegistry",
     "make_http_handler",
     "prepare_image",
+    "repin_target",
     "serve_http",
 ]

@@ -127,10 +127,11 @@ def priced_deadline_s(ledger, name_prefix: str, shape, *,
 
 class _WorkItem:
     __slots__ = ("bucket_hw", "batch", "requests", "redispatches",
-                 "t_enqueue", "seq", "cost_px", "min_deadline")
+                 "t_enqueue", "seq", "cost_px", "min_deadline", "pin")
 
     def __init__(self, bucket_hw, batch, requests, *,
-                 t_enqueue: float = 0.0, seq: int = 0):
+                 t_enqueue: float = 0.0, seq: int = 0,
+                 pin: Optional[int] = None):
         self.bucket_hw = bucket_hw
         self.batch = batch
         self.requests = requests
@@ -145,6 +146,11 @@ class _WorkItem:
         deadlines = [r.deadline_ts for r in requests
                      if r.deadline_ts is not None]
         self.min_deadline = min(deadlines) if deadlines else None
+        # sticky stream routing (serve/streams.py): the replica index
+        # this batch's streams prefer — a dispatch-ordering PREFERENCE
+        # only, validated live by the service before enqueue, so a pin
+        # to a dead replica never reaches the queue
+        self.pin = pin
 
 
 class ReplicaState:
@@ -473,12 +479,26 @@ class FleetEngine:
                                               "still queued"))
 
     # -- dispatch ---------------------------------------------------------
-    def submit_work(self, bucket_hw, batch, requests) -> None:
+    def live_tokens(self) -> dict:
+        """``{replica index: incarnation token}`` of the ACTIVE set —
+        what the stream registry validates pins against.  The token is
+        the engine's program name: a resurrection REPLACES the engine
+        under a fresh name, so a pin into an abandoned incarnation
+        fails the token match even though the index came back."""
+        with self._cond:
+            return {r.index: r.engine.name for r in self.replicas
+                    if r.state == REPLICA_ACTIVE}
+
+    def submit_work(self, bucket_hw, batch, requests, *,
+                    pin: Optional[int] = None) -> None:
         """Called by the service's dispatch (the batcher thread): enqueue
-        one assembled micro-batch for whichever replica frees up first."""
+        one assembled micro-batch for whichever replica frees up first
+        (``pin`` biases the priced pick toward that replica — stream
+        locality — without ever reserving the item for it)."""
         with self._cond:
             item = _WorkItem(bucket_hw, batch, requests,
-                             t_enqueue=self._clock(), seq=self._work_seq)
+                             t_enqueue=self._clock(), seq=self._work_seq,
+                             pin=pin)
             self._work_seq += 1
             if not self._closed and self.live_replicas() > 0:
                 self._queue.append(item)
@@ -488,10 +508,12 @@ class FleetEngine:
         self._fail(item, FleetClosedError(
             "fleet closed" if closed else "no live replicas"))
 
-    def _pop_next_locked(self) -> _WorkItem:
+    def _pop_next_locked(self, replica: Optional[ReplicaState] = None
+                         ) -> _WorkItem:
         """Next work item under ``_cond``: the scheduling core's priced
         order (urgent deadline-pressured work EDF-first, the rest
-        cheapest-first, age-promoted against starvation) — or plain FIFO
+        cheapest-first, age-promoted against starvation, stream pins as
+        an affinity preference for the pulling replica) — or plain FIFO
         when configured.  A redispatched batch sits at the queue FRONT
         and is also urgent-class, so both orders serve it first."""
         if self.dispatch_order == "fifo" or len(self._queue) == 1:
@@ -500,7 +522,8 @@ class FleetEngine:
 
         i = pick_work(self._queue, self._clock(),
                       starvation_age_s=self.starvation_age_s,
-                      pressure_s=self.deadline_pressure_s)
+                      pressure_s=self.deadline_pressure_s,
+                      prefer=None if replica is None else replica.index)
         item = self._queue[i]
         del self._queue[i]
         return item
@@ -511,7 +534,7 @@ class FleetEngine:
                 if replica.state != REPLICA_ACTIVE:
                     return None
                 if self._queue:
-                    return self._pop_next_locked()
+                    return self._pop_next_locked(replica)
                 if self._closed:
                     return None
                 self._cond.wait(0.1)

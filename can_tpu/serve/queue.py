@@ -40,6 +40,11 @@ REJECT_BACKPRESSURE = "backpressure"  # load shedding above high_water
 REJECT_DEADLINE = "deadline"          # deadline expired before dispatch
 REJECT_SHUTDOWN = "shutdown"          # service closed with the request queued
 REJECT_ERROR = "error"                # dispatch raised; message in detail
+# stream sessions (serve/streams.py): a duplicate/out-of-order frame of
+# a stream's monotonic sequence, and the degradation ladder's last rung
+# (arrival rate sustained past drain capacity with nothing left to skip)
+REJECT_STALE_FRAME = "stale_frame"
+REJECT_STREAM_OVERLOAD = "stream_overload"
 
 
 class RejectedError(RuntimeError):
@@ -66,6 +71,14 @@ class ServeResult:
     queue_wait_s: Optional[float] = None  # submit -> batch assembly start
     device_s: Optional[float] = None      # engine execute wall time
     trace_id: Optional[str] = None        # the request's span-tree id
+    # stream sessions (serve/streams.py): a degraded answer was served
+    # from the stream's EWMA (the frame-skip rung — no launch ran) and
+    # is ``staleness_s`` seconds older than a fresh inference would be.
+    # Both default to the non-stream values, so every pre-stream caller
+    # reads this dataclass unchanged.
+    degraded: bool = False
+    staleness_s: Optional[float] = None
+    stream_id: Optional[str] = None
 
 
 class ServeRequest:
@@ -79,20 +92,29 @@ class ServeRequest:
     _ids = itertools.count()
 
     def __init__(self, image: np.ndarray, *, deadline_s: Optional[float],
-                 want_density: bool = False, clock=time.monotonic):
+                 want_density: bool = False, clock=time.monotonic,
+                 stream_id: Optional[str] = None,
+                 frame_seq: Optional[int] = None):
         self.id = next(self._ids)
         self.image = image
         self.shape = tuple(image.shape[:2])
         self.want_density = bool(want_density)
+        # stream sessions (serve/streams.py): which camera this frame
+        # belongs to and its monotonic sequence number; None keeps the
+        # exact stateless request path
+        self.stream_id = stream_id
+        self.frame_seq = None if frame_seq is None else int(frame_seq)
         self.t_submit = clock()
         self.deadline_ts = (None if deadline_s is None
                             else self.t_submit + float(deadline_s))
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
         self._reject: Optional[RejectedError] = None
-        # set by the queue at admission: fires exactly once when the
-        # request resolves/rejects, so the queue can track outstanding load
-        self._on_done = None
+        # done hooks: each fires exactly once when the request resolves
+        # or rejects — the queue tracks outstanding load here, and the
+        # stream registry tracks per-stream backlog (two independent
+        # observers, so a single slot would drop one)
+        self._done_hooks: List = []
         # span plumbing (all in the request's own clock): trace_id is
         # minted by CountService.submit; the batcher stamps the assembly
         # window so the service can price queue-wait vs device time
@@ -103,9 +125,17 @@ class ServeRequest:
     def expired(self, now: float) -> bool:
         return self.deadline_ts is not None and now >= self.deadline_ts
 
+    def add_done_hook(self, hook) -> None:
+        """Register ``hook(request)`` to fire exactly once at
+        resolution/rejection (immediately if already done)."""
+        if self._done.is_set():
+            hook(self)
+            return
+        self._done_hooks.append(hook)
+
     def _fire_done(self) -> None:
-        hook, self._on_done = self._on_done, None
-        if hook is not None:
+        hooks, self._done_hooks = self._done_hooks, []
+        for hook in hooks:
             hook(self)
 
     def resolve(self, result: ServeResult) -> None:
@@ -190,9 +220,15 @@ class BoundedRequestQueue:
                     and self._outstanding <= self.low_water):
                 self._shedding = False
 
-    def offer(self, request: ServeRequest) -> Optional[str]:
+    def offer(self, request: ServeRequest, *,
+              reject: bool = True) -> Optional[str]:
         """Admit ``request`` or reject it; returns the reject reason (also
-        recorded on the request) or None when admitted."""
+        recorded on the request) or None when admitted.
+
+        ``reject=False`` returns the reason WITHOUT rejecting the
+        request — the stream path's degrade-instead-of-drown hook: a
+        refused stream frame falls back to its session EWMA (the caller
+        resolves or rejects it, exactly once either way)."""
         with self._lock:
             if self._closed:
                 reason = REJECT_SHUTDOWN
@@ -204,12 +240,13 @@ class BoundedRequestQueue:
                     self._shedding = True
                 reason = REJECT_BACKPRESSURE if self._shedding else None
             if reason is None:
-                request._on_done = self._request_done
+                request.add_done_hook(self._request_done)
                 self._outstanding += 1
                 self._items.append(request)
                 self._nonempty.notify()
                 return None
-        request.reject(reason, f"outstanding {self.outstanding()}")
+        if reject:
+            request.reject(reason, f"outstanding {self.outstanding()}")
         return reason
 
     def wait_nonempty(self, timeout: Optional[float]) -> bool:

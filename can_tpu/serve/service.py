@@ -49,11 +49,14 @@ from can_tpu.serve.engine import ServeEngine
 from can_tpu.serve.queue import (
     REJECT_ERROR,
     REJECT_SHUTDOWN,
+    REJECT_STALE_FRAME,
+    REJECT_STREAM_OVERLOAD,
     BoundedRequestQueue,
     RejectedError,
     ServeRequest,
     ServeResult,
 )
+from can_tpu.serve.streams import StreamSessionRegistry
 from can_tpu.utils.profiling import StepTimer
 
 
@@ -131,7 +134,10 @@ class CountService:
                  telemetry=None, clock=time.monotonic,
                  perf_summary_every: int = 32,
                  menu_budget: Optional[int] = None,
-                 flush_policy: str = "priced"):
+                 flush_policy: str = "priced",
+                 stream_ttl_s: float = 300.0,
+                 degrade_policy: str = "priced",
+                 max_body_mb: float = 64.0):
         if flush_policy not in ("priced", "timer"):
             raise ValueError(f"unknown flush_policy {flush_policy!r} "
                              f"(priced | timer)")
@@ -187,7 +193,21 @@ class CountService:
         self.latency = StepTimer(skip_first=0)
         self._lock = threading.Lock()
         self._stats = {"submitted": 0, "completed": 0, "rejected": 0,
+                       "degraded": 0,
                        "batches": 0, "batch_slots": 0, "batch_valid": 0}
+        # stream sessions (serve/streams.py): HOST-side per-stream state
+        # — count/density EWMAs, sequence hygiene, the degradation
+        # ladder, sticky replica pins.  Living here (never on a replica)
+        # is what makes sessions survive quarantine, wedge,
+        # resurrection, rollout, and scale events by construction.
+        # Requests without a stream_id never touch it.
+        if max_body_mb <= 0:
+            raise ValueError(f"max_body_mb must be positive, got "
+                             f"{max_body_mb}")
+        self.max_body_bytes = int(float(max_body_mb) * 2 ** 20)
+        self.streams = StreamSessionRegistry(
+            ttl_s=stream_ttl_s, clock=clock, telemetry=self.telemetry,
+            sched=self.sched, policy=degrade_policy)
         self._started = False
         self._closed = False
         # image dtypes warmup() has compiled — the HTTP raw=1 gate: an
@@ -281,15 +301,32 @@ class CountService:
     # -- the programmatic API --------------------------------------------
     def submit(self, image: np.ndarray, *,
                deadline_ms: Optional[float] = None,
-               want_density: bool = False) -> ServeTicket:
+               want_density: bool = False,
+               stream_id: Optional[str] = None,
+               frame_seq: Optional[int] = None) -> ServeTicket:
         """Enqueue one prepared image (see ``prepare_image``).  Returns a
         ticket whose ``result()`` either yields a ``ServeResult`` or raises
         ``RejectedError`` — immediate rejection (full queue, shedding,
-        shutdown) still returns a ticket, with the rejection stored."""
+        shutdown) still returns a ticket, with the rejection stored.
+
+        ``stream_id`` opts the request into a per-stream session
+        (serve/streams.py): sequence hygiene on ``frame_seq``, sticky
+        replica routing, and the degradation ladder — under overload the
+        frame may be answered from the stream's EWMA (``degraded: true``
+        + staleness on the result) instead of launched or rejected.
+        Without a stream_id the request takes the EXACT stateless path
+        (pinned by test)."""
+        if frame_seq is not None and stream_id is None:
+            # same validation as the HTTP layer: silently dropping the
+            # seq would leave a caller believing the sequence gate is
+            # on while duplicates sail through
+            raise ValueError("frame_seq needs a stream_id (the sequence "
+                             "gate is per-stream)")
         deadline_s = (float(deadline_ms) / 1e3 if deadline_ms is not None
                       else self.default_deadline_s)
         req = ServeRequest(np.asarray(image), deadline_s=deadline_s,
-                           want_density=want_density, clock=self._clock)
+                           want_density=want_density, clock=self._clock,
+                           stream_id=stream_id, frame_seq=frame_seq)
         # the trace is born at the front door: every span of this
         # request's life (queue wait -> assembly -> device -> respond)
         # keys on this id, and HTTP clients get it back in the response
@@ -313,18 +350,102 @@ class CountService:
             req.reject(REJECT_SHUTDOWN, "service closed")
             self._count_reject(REJECT_SHUTDOWN)
             return ServeTicket(req, self)
-        reason = self.queue.offer(req)
+        if stream_id is None:
+            reason = self.queue.offer(req)
+            if reason is not None:
+                self._count_reject(reason)
+            return ServeTicket(req, self)
+        return self._submit_stream(req, bucket)
+
+    def _submit_stream(self, req: ServeRequest,
+                       bucket) -> ServeTicket:
+        """The stream admission path: registry decision first (sequence
+        gate + degradation ladder), then the queue — and a queue refusal
+        degrades to the EWMA when one exists instead of rejecting (the
+        "degrade instead of drown" rung the ladder's pricing may not
+        have caught yet)."""
+        now = self._clock()
+        dec = self.streams.admit(req.stream_id, req.frame_seq, now,
+                                 bucket)
+        if dec.kind == "stale":
+            req.reject(REJECT_STALE_FRAME, dec.detail)
+            self._count_reject(REJECT_STALE_FRAME)
+            return ServeTicket(req, self)
+        if dec.kind == "overload":
+            req.reject(REJECT_STREAM_OVERLOAD, dec.detail)
+            self._count_reject(REJECT_STREAM_OVERLOAD)
+            return ServeTicket(req, self)
+        if dec.kind == "degrade":
+            self._resolve_degraded(req, bucket, dec)
+            return ServeTicket(req, self)
+        self.streams.note_admitted(req)
+        reason = self.queue.offer(req, reject=False)
         if reason is not None:
-            self._count_reject(reason)
+            fb = self.streams.degrade_fallback(req.stream_id, now)
+            if fb is not None:
+                self._resolve_degraded(req, bucket, fb,
+                                       fallback=reason)
+            else:
+                # refused with nothing to degrade to: un-commit the
+                # frame's sequence so the camera's RETRY of this
+                # never-answered frame passes the gate instead of
+                # bouncing off it as stale_frame forever
+                self.streams.rollback_seq(req.stream_id, req.frame_seq,
+                                          dec.prior_seq)
+                req.reject(reason,
+                           f"outstanding {self.queue.outstanding()}")
+                self._count_reject(reason)
         return ServeTicket(req, self)
+
+    def _resolve_degraded(self, req: ServeRequest, bucket, dec,
+                          fallback: Optional[str] = None) -> None:
+        """Answer a stream frame from its session EWMA — no queue, no
+        batch, no launch: a degraded answer must be CHEAP.  Labelled
+        ``degraded: true`` with staleness seconds on both the result
+        and the ``serve.request`` event; deliberately kept OUT of the
+        device-latency reservoir (an instant EWMA answer in the p99
+        would make overload look like a latency win)."""
+        now = self._clock()
+        dens = None
+        if req.want_density and dec.density is not None:
+            h, w = req.shape
+            d = dec.density
+            if d.shape[:2] == (h // self.engine.ds, w // self.engine.ds):
+                dens = d
+        res = ServeResult(count=float(dec.count), density=dens,
+                          bucket_hw=tuple(bucket), batch_fill=0.0,
+                          latency_s=now - req.t_submit,
+                          queue_wait_s=0.0, device_s=0.0,
+                          trace_id=req.trace_id, degraded=True,
+                          staleness_s=dec.staleness_s,
+                          stream_id=req.stream_id)
+        req.resolve(res)
+        with self._lock:
+            self._stats["completed"] += 1
+            self._stats["degraded"] += 1
+        payload = {"request_id": req.id,
+                   "latency_s": round(res.latency_s, 6),
+                   "bucket": list(bucket), "ok": True,
+                   "trace_id": req.trace_id, "degraded": True,
+                   "stream": req.stream_id}
+        if dec.staleness_s is not None:
+            payload["staleness_s"] = dec.staleness_s
+        if fallback is not None:
+            # the queue refused this frame (queue_full/backpressure);
+            # the session EWMA absorbed it instead of a reject
+            payload["fallback"] = fallback
+        self.telemetry.emit("serve.request", **payload)
 
     def predict(self, image: np.ndarray, *,
                 deadline_ms: Optional[float] = None,
                 want_density: bool = False,
-                timeout: Optional[float] = None) -> ServeResult:
+                timeout: Optional[float] = None,
+                stream_id: Optional[str] = None,
+                frame_seq: Optional[int] = None) -> ServeResult:
         """submit + result in one call (the closed-loop client pattern)."""
         return self.submit(image, deadline_ms=deadline_ms,
-                           want_density=want_density).result(timeout)
+                           want_density=want_density, stream_id=stream_id,
+                           frame_seq=frame_seq).result(timeout)
 
     def stats(self) -> dict:
         with self._lock:
@@ -341,6 +462,9 @@ class CountService:
             "latency_p95_s": lat["p95_s"],
             "latency_max_s": lat["max_s"],
             "compile_count": self.engine.compile_count,
+            # per-stream sessions (serve/streams.py): the operator's
+            # view of the degradation ladder and sticky routing
+            "streams": self.streams.stats(),
         }
         if self._fleet is not None:
             # per-replica rows: service-side work counters joined with the
@@ -376,8 +500,17 @@ class CountService:
     def _dispatch(self, bucket_hw, batch, requests) -> None:
         if self._fleet is not None:
             # hand the assembled batch to whichever replica frees up
-            # first; the worker thread calls _complete (or _fail_batch)
-            self._fleet.submit_work(bucket_hw, batch, requests)
+            # first; the worker thread calls _complete (or _fail_batch).
+            # Stream batches carry their sticky pin (validated against
+            # the LIVE replica set right here — a pin to a quarantined/
+            # wedged/replaced incarnation is re-pinned before it can
+            # queue behind a corpse)
+            pin = None
+            if (self.streams.active_count()
+                    and hasattr(self._fleet, "live_tokens")):
+                pin = self.streams.pin_for(requests,
+                                           self._fleet.live_tokens())
+            self._fleet.submit_work(bucket_hw, batch, requests, pin=pin)
             return
         t_exec0 = self._clock()
         t0 = time.perf_counter()
@@ -422,12 +555,24 @@ class CountService:
             t_asm = req.t_assembly if req.t_assembly is not None else t_exec0
             t_ready = req.t_ready if req.t_ready is not None else t_exec0
             queue_wait = max(t_asm - req.t_submit, 0.0)
+            if req.stream_id is not None:
+                # fold the fresh count (and density, when fetched) into
+                # the stream's session BEFORE resolving: a degraded
+                # answer racing this completion serves the newest EWMA.
+                # The serving replica becomes the stream's sticky pin
+                # (first completion only; pins move via re-pin, not
+                # work stealing).
+                self.streams.note_completed(
+                    req.stream_id, float(counts[slot]), dens, bucket_hw,
+                    now=now, replica=replica,
+                    token=None if replica is None else program)
             req.resolve(ServeResult(count=float(counts[slot]), density=dens,
                                     bucket_hw=tuple(bucket_hw),
                                     batch_fill=fill, latency_s=latency,
                                     queue_wait_s=round(queue_wait, 6),
                                     device_s=round(execute_s, 6),
-                                    trace_id=req.trace_id))
+                                    trace_id=req.trace_id,
+                                    stream_id=req.stream_id))
             with self._lock:
                 self.latency.record(latency, shape=tuple(bucket_hw))
             self.telemetry.emit("serve.request", request_id=req.id,
@@ -476,6 +621,11 @@ class CountService:
         # legacy no-core service predicts its own contract: every launch
         # pads to max_batch.
         slots = batch.image.shape[0]
+        # drain pricing for the stream degradation ladder: every
+        # completed batch (stream or not) feeds the bucket's measured
+        # seconds-per-slot, so the pricing is warm before the first
+        # stream needs a skip decision
+        self.streams.observe_batch(bucket_hw, execute_s, slots)
         area = float(bucket_hw[0] * bucket_hw[1])
         if self.sched is not None:
             predicted = self.sched.predicted_cost_px(area, len(requests))
@@ -570,10 +720,17 @@ def make_http_handler(service: CountService):
                      image); query: ?deadline_ms=&density=1&raw=1
                      (raw=1 keeps uint8 pixels and normalises ON DEVICE —
                      the u8 transfer mode; needs the u8 programs warmed,
-                     cli --u8-warmup)
+                     cli --u8-warmup); ?stream_id=cam1&frame_seq=17 opts
+                     into a per-stream session (serve/streams.py):
+                     sticky routing, sequence hygiene, and the
+                     degradation ladder — a frame-skipped answer carries
+                     "degraded": true + "staleness_s"
                      -> 200 {"count", "latency_ms", "bucket", "batch_fill"
-                             [, "density"]}
-                     -> 408/503 {"error", "reason"} on deadline/shedding
+                             [, "density"]}; stream requests add
+                             {"degraded"[, "staleness_s"]}
+                     -> 408/503 {"error", "reason"} on deadline/shedding;
+                        409 on a stale/duplicate frame_seq; 413 when the
+                        body exceeds --max-body-mb
     GET  /healthz    -> 200/503 {"ok", ...}; fleet services add per-
                      replica state (quarantine visible here), live count,
                      generation — 503 when zero replicas are live
@@ -596,7 +753,10 @@ def make_http_handler(service: CountService):
     )
 
     status_of = {REJECT_DEADLINE: 408, REJECT_QUEUE_FULL: 503,
-                 REJECT_BACKPRESSURE: 503, REJECT_SHUTDOWN: 503}
+                 REJECT_BACKPRESSURE: 503, REJECT_SHUTDOWN: 503,
+                 # a stale/duplicate stream frame is the client's
+                 # ordering problem (409), not server load (503)
+                 REJECT_STALE_FRAME: 409, REJECT_STREAM_OVERLOAD: 503}
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -608,6 +768,32 @@ def make_http_handler(service: CountService):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _body_capped(self) -> Optional[int]:
+            """Content-Length, or None after answering 413/400: a
+            multi-GB POST must be refused BEFORE ``rfile.read``
+            materialises it on the serve host (the DoS shape: one
+            request, whole-host OOM).  A malformed or NEGATIVE header
+            is a 400 — ``rfile.read(-1)`` would read until EOF, which
+            on a keep-alive socket is never: the handler thread hangs,
+            and a handful of such requests exhaust the thread pool
+            (the same DoS through the guard's own gap).  The cap is
+            named so the operator knows which knob moves it."""
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                if n < 0:
+                    raise ValueError(f"negative Content-Length {n}")
+            except ValueError as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return None
+            if n > service.max_body_bytes:
+                self._send(413, {
+                    "error": f"request body {n} bytes exceeds the "
+                             f"{service.max_body_bytes} byte cap "
+                             f"(--max-body-mb="
+                             f"{service.max_body_bytes / 2 ** 20:g})"})
+                return None
+            return n
 
         def log_message(self, fmt, *args):  # quiet: telemetry is the log
             pass
@@ -623,6 +809,12 @@ def make_http_handler(service: CountService):
                 self._send(404, {"error": f"no such path: {path}"})
 
         def _do_rollout(self):
+            # cap FIRST: an oversized body is refused regardless of
+            # rollout wiring (the 413 is the DoS guard, not a feature
+            # of the endpoint)
+            n = self._body_capped()
+            if n is None:
+                return
             loader = getattr(service, "rollout_loader", None)
             if loader is None:
                 self._send(501, {"error": "rollout is not wired on this "
@@ -630,7 +822,6 @@ def make_http_handler(service: CountService):
                                           "fleet CLI serves wire it)"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", "0"))
                 spec = json.loads(self.rfile.read(n) or b"{}")
                 if not isinstance(spec, dict):
                     raise ValueError("rollout body must be a JSON object")
@@ -663,8 +854,10 @@ def make_http_handler(service: CountService):
             if url.path != "/predict":
                 self._send(404, {"error": f"no such path: {url.path}"})
                 return
+            n = self._body_capped()
+            if n is None:
+                return
             try:
-                n = int(self.headers.get("Content-Length", "0"))
                 arr = np.load(io.BytesIO(self.rfile.read(n)),
                               allow_pickle=False)
                 q = parse_qs(url.query)
@@ -672,6 +865,11 @@ def make_http_handler(service: CountService):
                                if "deadline_ms" in q else None)
                 want_density = q.get("density", ["0"])[0] not in ("0", "")
                 raw = q.get("raw", ["0"])[0] not in ("0", "")
+                stream_id = q.get("stream_id", [None])[0] or None
+                frame_seq = (int(q["frame_seq"][0])
+                             if "frame_seq" in q else None)
+                if frame_seq is not None and stream_id is None:
+                    raise ValueError("frame_seq needs a stream_id")
                 if raw and arr.dtype != np.uint8:
                     raise ValueError("raw=1 needs uint8 pixels")
                 if raw and np.dtype(np.uint8) not in service.warmed_dtypes:
@@ -688,7 +886,9 @@ def make_http_handler(service: CountService):
                 return
             try:
                 res = service.predict(image, deadline_ms=deadline_ms,
-                                      want_density=want_density)
+                                      want_density=want_density,
+                                      stream_id=stream_id,
+                                      frame_seq=frame_seq)
             except ValueError as e:  # submit-side validation: client error
                 self._send(400, {"error": f"bad request: {e}"})
                 return
@@ -706,6 +906,13 @@ def make_http_handler(service: CountService):
                 payload["trace_id"] = res.trace_id
             if res.queue_wait_s is not None:
                 payload["queue_wait_ms"] = round(res.queue_wait_s * 1e3, 3)
+            if stream_id is not None:
+                # stream answers are LABELLED: a client can always tell
+                # a fresh inference from a served EWMA.  Non-stream
+                # responses keep the exact pre-stream body (pinned)
+                payload["degraded"] = bool(res.degraded)
+                if res.staleness_s is not None:
+                    payload["staleness_s"] = round(res.staleness_s, 6)
             if res.density is not None:
                 payload["density"] = res.density[..., 0].tolist()
             self._send(200, payload)

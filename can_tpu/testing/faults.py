@@ -21,7 +21,11 @@ point every worker at it).  Schema::
          "delay_s": 30.0},
         {"kind": "replica_crash", "replica": 0, "batch": 3},
         {"kind": "replica_hang", "replica": 1, "batch": 2,
-         "delay_s": 30.0}
+         "delay_s": 30.0},
+        {"kind": "stream_burst", "stream": "cam0", "frame": 5,
+         "burst": 8},
+        {"kind": "frame_gap", "stream": "cam1", "frame": 4,
+         "mode": "dup"}
     ]}
 
 * ``kill`` — at the matching (rank, epoch, step) boundary the injector
@@ -48,11 +52,30 @@ point every worker at it).  Schema::
   wedged device execute from the fleet's point of view — so the hang
   watchdog's priced deadline, batch re-dispatch, and
   wedged-replica probation run for real.  Fires once.
+* ``stream_burst`` — stream-driver-side: when the matching (stream,
+  frame) is about to be sent, the driver submits ``burst`` EXTRA frames
+  back-to-back first — an arrival-rate spike on ONE camera, the load
+  shape the degradation ladder (serve/streams.py) exists to absorb
+  without drowning the other streams.  Fires once.
+* ``frame_gap`` — stream-driver-side: the matching (stream, frame) is
+  delivered wrong — ``mode: "dup"`` re-sends the previous frame's
+  sequence number, ``mode: "reorder"`` sends this frame's seq minus
+  two (an out-of-order arrival) — so the session's monotonic-sequence
+  gate (duplicate/out-of-order rejection, never double-serve) runs for
+  real.  Fires once.
+
+The stream kinds are directives to the DRIVER (the chaos test's and
+bench tier's stream load generators call ``on_stream_frame`` before
+each submit and perturb their own traffic), because arrival timing and
+frame ordering belong to the client side of the protocol — the serving
+stack under test must see them arrive exactly as a misbehaving camera
+would send them.
 
 Hooks are consulted only from sites that already gate on
 ``active_injector()`` (train-loop elastic hook, checkpoint retry loop,
-``runtime.barrier``, the fleet worker's ``on_serve_batch``) — a
-production run without the env var never constructs an injector.
+``runtime.barrier``, the fleet worker's ``on_serve_batch``, the stream
+drivers' ``on_stream_frame``) — a production run without the env var
+never constructs an injector.
 
 ``make_kill_schedule`` derives the kill step from a seed (the "seeded
 schedule of kill-rank-k-at-step-s"): chaos runs randomise WHERE the
@@ -116,8 +139,14 @@ class FaultInjector:
             if not isinstance(f, dict) or "kind" not in f:
                 raise ValueError(f"malformed fault entry: {f!r}")
             if f["kind"] not in ("kill", "ckpt_io", "rendezvous_timeout",
-                                 "replica_crash", "replica_hang"):
+                                 "replica_crash", "replica_hang",
+                                 "stream_burst", "frame_gap"):
                 raise ValueError(f"unknown fault kind {f['kind']!r}")
+            if (f["kind"] == "frame_gap"
+                    and f.get("mode", "dup") not in ("dup", "reorder")):
+                raise ValueError(
+                    f"frame_gap mode must be dup|reorder, got "
+                    f"{f.get('mode')!r}")
             self.faults.append(dict(f))
         self._ckpt_attempts: Dict[str, int] = {}
         self.fired: List[dict] = []  # delivered faults, for assertions
@@ -182,6 +211,29 @@ class FaultInjector:
                 raise InjectedFault(
                     f"injected replica {replica} crash at batch "
                     f"{batch_index}")
+
+    def on_stream_frame(self, *, stream: str = "",
+                        frame: int = 1) -> Optional[dict]:
+        """Stream-driver boundary (consulted BEFORE the driver submits
+        the matching 1-based ``frame`` of ``stream``): returns the
+        matching directive — ``{"kind": "stream_burst", "burst": n}``
+        (submit n extra frames back-to-back first) or ``{"kind":
+        "frame_gap", "mode": "dup"|"reorder"}`` (deliver this frame
+        duplicated / out of order) — or None.  Fires once per entry."""
+        for f in self.faults:
+            if (f["kind"] not in ("stream_burst", "frame_gap")
+                    or f.get("_fired")
+                    or str(f.get("stream", "")) != stream
+                    or int(f.get("frame", 1)) != frame):
+                continue
+            f["_fired"] = True
+            self.fired.append(f)
+            if f["kind"] == "stream_burst":
+                return {"kind": "stream_burst",
+                        "burst": int(f.get("burst", 8))}
+            return {"kind": "frame_gap",
+                    "mode": str(f.get("mode", "dup"))}
+        return None
 
     def on_barrier(self, name: str, *, rank: int = 0) -> None:
         """Barrier entry: the matching rank HOLDS the barrier for

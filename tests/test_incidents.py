@@ -677,7 +677,7 @@ class TestSloReportCLI:
         """Artifact pin: the committed fleet-bench-era fixture grades
         green against the committed example spec — exactly what the CI
         gate (CI_BENCH_ONLY=slo) runs."""
-        r = run_slo_report("SLO_FIXTURE_cpu_r12.jsonl",
+        r = run_slo_report("SLO_FIXTURE_cpu_r15.jsonl",
                            "--spec", "slo_spec.json")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "PASS" in r.stdout
@@ -690,19 +690,19 @@ class TestSloReportCLI:
         spec["objectives"][0]["burn_alert"] = 2.0
         p = tmp_path / "tight.json"
         p.write_text(json.dumps(spec))
-        r = run_slo_report("SLO_FIXTURE_cpu_r12.jsonl", "--spec", str(p))
+        r = run_slo_report("SLO_FIXTURE_cpu_r15.jsonl", "--spec", str(p))
         assert r.returncode == 1
         assert "VIOLATION serve_p99_deadline" in r.stdout
         assert "window 60+300" in r.stdout
 
     def test_usage_errors_exit_2(self, tmp_path):
-        r = run_slo_report("SLO_FIXTURE_cpu_r12.jsonl",
+        r = run_slo_report("SLO_FIXTURE_cpu_r15.jsonl",
                            "--spec", str(tmp_path / "absent.json"))
         assert r.returncode == 2
         bad = tmp_path / "bad.json"
         bad.write_text(json.dumps({"version": 1, "objectives": [
             {"name": "x", "event": "stall", "target": 2.0}]}))
-        r = run_slo_report("SLO_FIXTURE_cpu_r12.jsonl", "--spec", str(bad))
+        r = run_slo_report("SLO_FIXTURE_cpu_r15.jsonl", "--spec", str(bad))
         assert r.returncode == 2 and "target" in r.stderr
         r = run_slo_report(str(tmp_path / "nothing.jsonl"),
                            "--spec", "slo_spec.json")

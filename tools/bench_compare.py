@@ -60,7 +60,9 @@ def _direction(unit: str) -> int:
     path lost a fusion — shrinking bytes IS the improvement, so gbytes
     stays one-sided), 0 unknown (never gates)."""
     u = (unit or "").lower()
-    if u == "fill_pct":
+    if u in ("fill_pct", "streams"):
+        # streams: the stream tier's streams-per-device capacity —
+        # fewer cameras sustained inside the deadline is the regression
         return +1
     if "/sec" in u or "/s" in u:
         return +1

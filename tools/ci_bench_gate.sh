@@ -40,9 +40,21 @@
 #       through the priced menu + priced-flush service on ONE device
 #       (no cpu8 needed)
 #
+#   CI_BENCH_ONLY=stream tools/ci_bench_gate.sh BENCH_STREAM_cpu_r15.json
+#       gates the streaming-session tier (serve/streams.py): sustained
+#       per-stream p99 (ms, upward) and served rate / streams-per-device
+#       at a fixed deadline (req/s and unit ``streams``, both gated on
+#       decrease), plus degraded-answer p99 under capacity-probed 2x
+#       overload (ms, upward — a degraded answer is an EWMA lookup and
+#       must stay cheap).  The degradation fraction and the legacy
+#       (no-session) arm's reject fraction ride the artifact ungated as
+#       the ladder-engagement receipt.  Single device, no cpu8 needed.
+#
 #   CI_BENCH_ONLY=slo tools/ci_bench_gate.sh
 #       gates the SLO layer: tools/slo_report.py grades the committed
-#       fleet-bench-era telemetry fixture (SLO_FIXTURE_cpu_r12.jsonl)
+#       telemetry fixture (SLO_FIXTURE_cpu_r15.jsonl: the r12
+#       fleet-bench-era run extended with a real streamed-serve run so
+#       the stream_staleness objective is exercised)
 #       against the committed example spec (slo_spec.json) — exit 1 if
 #       the spec/fixture pair drifts into violation, exit 2 if either
 #       artifact is broken.  Compare-only by construction: the report
@@ -84,7 +96,7 @@ ONLY=${CI_BENCH_ONLY:-host}
 if [ "$ONLY" = "slo" ]; then
     cd "$(dirname "$0")/.."
     exec python tools/slo_report.py \
-        "${CI_SLO_FIXTURE:-SLO_FIXTURE_cpu_r12.jsonl}" \
+        "${CI_SLO_FIXTURE:-SLO_FIXTURE_cpu_r15.jsonl}" \
         --spec "${CI_SLO_SPEC:-slo_spec.json}"
 fi
 
@@ -133,12 +145,16 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # the sched tier's artifact defaults to the committed
     # BENCH_SCHED_cpu_r14.json exactly when BENCH_SUITE_ONLY=sched,
     # which is how this gate runs it.
+    # BENCH_STREAM_OUT: sixth instance — the stream tier's artifact
+    # defaults to the committed BENCH_STREAM_cpu_r15.json exactly when
+    # BENCH_SUITE_ONLY=stream, which is how this gate runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
         BENCH_FLEET_OUT="${BENCH_FLEET_OUT:-${OUT}.fleet.json}" \
         BENCH_AUTOSCALE_OUT="${BENCH_AUTOSCALE_OUT:-${OUT}.autoscale.json}" \
         BENCH_SCHED_OUT="${BENCH_SCHED_OUT:-${OUT}.sched.json}" \
+        BENCH_STREAM_OUT="${BENCH_STREAM_OUT:-${OUT}.stream.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi
