@@ -1518,6 +1518,128 @@ def bench_autoscale(*, replicas=2, n_requests=32, repeats=3, max_batch=4,
     return records
 
 
+def _rss_mb() -> float:
+    """Current process resident set, MB (/proc VmRSS; ru_maxrss peak as
+    the fallback on boxes without /proc)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_obsplane(*, hosts=4, events_per_host=2000, batch=200,
+                   repeats=3, out_path=None) -> list:
+    """Fleet-observability-plane tier (r16): the collector's ingest
+    throughput, steady-state memory, and scrape cost at ``hosts``
+    simulated pushers (obs/collector.py).
+
+    Pure host-side — no device work; the numbers bound how much fleet
+    telemetry one collector absorbs before it, not the run, is the
+    bottleneck.  The workload is the real push path end to end: batched
+    JSONL bodies through ``ingest_push`` (parse + skew sampling + gauges
+    + ring + watermark merge) with the global SLO engine grading the
+    merged stream, one host running 120 s fast to keep the correction
+    in the measured path.  Gated records: ``obsplane_ingest_events_per_s``
+    (events/s, downward = regression), ``obsplane_rss_mb`` (mb, upward =
+    the bounded-ring discipline leaked; rings and pending queues are the
+    ONLY per-host state allowed to grow), ``obsplane_scrape_ms`` (ms —
+    the /metrics text render over the full fleet)."""
+    import statistics
+
+    from can_tpu.obs.collector import FleetCollector
+    from can_tpu.obs.slo import load_slo_spec
+
+    spec = load_slo_spec(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "slo_spec.json"))
+    base_ts = 1_000_000.0
+    # host 1 runs 120 s fast: every rep exercises offset freezing and
+    # the corrected-release path, not just the zero-skew fast path
+    skews = {h: (120.0 if h == 1 else 0.0) for h in range(hosts)}
+
+    def host_batches(h):
+        evs = []
+        for i in range(events_per_host):
+            ts = base_ts + skews[h] + i * 0.05
+            if i % 50 == 0:
+                evs.append({"ts": ts, "host_id": h, "kind": "heartbeat",
+                            "payload": {"seq": i // 50,
+                                        "start_ts": base_ts + skews[h]}})
+            else:
+                evs.append({"ts": ts, "host_id": h,
+                            "kind": "serve.request",
+                            "payload": {"latency_s":
+                                        0.02 if i % 10 else 3.0}})
+        return ["\n".join(json.dumps(e) for e in evs[j:j + batch]) + "\n"
+                for j in range(0, len(evs), batch)]
+
+    bodies = {h: [b.encode() for b in host_batches(h)] for h in
+              range(hosts)}
+    total_events = hosts * events_per_host
+    med = statistics.median
+    spread = lambda xs: round(  # noqa: E731
+        100.0 * (max(xs) - min(xs)) / max(abs(med(xs)), 1e-9), 1)
+    rates, scrapes, rss = [], [], []
+    evals = None
+    for rep in range(repeats):
+        col = FleetCollector(spec, poll_interval_s=3600.0)
+        n_batches = max(len(bodies[h]) for h in bodies)
+        t0 = time.perf_counter()
+        for j in range(n_batches):  # interleaved, like real pushers
+            for h in range(hosts):
+                if j < len(bodies[h]):
+                    col.ingest_push(bodies[h][j])
+            col.poll(now=base_ts + (j + 1) * batch * 0.05)
+        col.drain(now=base_ts + events_per_host * 0.05)
+        rates.append(total_events / (time.perf_counter() - t0))
+        t_s = [0.0] * 10
+        for k in range(len(t_s)):
+            s0 = time.perf_counter()
+            text = col.render_metrics()
+            t_s[k] = (time.perf_counter() - s0) * 1e3
+        assert "can_tpu_slo_burn_global" in text
+        scrapes.append(med(t_s))
+        rss.append(_rss_mb())
+        if evals is None:
+            evals = len(col.evals())
+        col.close(drain=False)
+    base = {"hosts": hosts, "events_per_host": events_per_host,
+            "batch": batch, "repeats": repeats, "evaluations": evals,
+            "conditions": "push path end-to-end (JSONL parse -> merge "
+                          "-> global SLO engine), host 1 skewed +120s"}
+    records = [
+        {"metric": "obsplane_ingest_events_per_s",
+         "value": round(med(rates), 1), "unit": "events/s",
+         "spread_pct": spread(rates), **base},
+        {"metric": "obsplane_rss_mb", "value": round(med(rss), 1),
+         "unit": "mb", "spread_pct": spread(rss), **base},
+        {"metric": "obsplane_scrape_ms", "value": round(med(scrapes), 3),
+         "unit": "ms", "spread_pct": spread(scrapes), **base},
+    ]
+    for r in records:
+        if _TELEMETRY is not None:
+            _TELEMETRY.emit("bench", **r)
+        print(json.dumps(r), flush=True)
+    out = out_path or os.environ.get("BENCH_OBSPLANE_OUT")
+    if not out:
+        # committed gate baseline only for an explicit obsplane-only run
+        # (the perf/bn/fleet/autoscale/sched/stream no-self-overwrite
+        # rule, 7th use)
+        out = ("BENCH_OBSPLANE_cpu_r16.json"
+               if os.environ.get("BENCH_SUITE_ONLY") == "obsplane"
+               else "BENCH_OBSPLANE_local.json")
+    doc = {"metric": "obsplane", "config": base, "results": records}
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# obsplane tier: {len(records)} records -> {out}", flush=True)
+    return records
+
+
 def bench_highres_eval(jnp, compute_dtype, *, h, w, steps, warmup=2):
     import jax
 
@@ -1623,6 +1745,8 @@ def main() -> None:
             bench_sched(n_requests=16, repeats=2)
         if want("stream"):
             bench_stream(n_streams=2, frames=6, repeats=2)
+        if want("obsplane"):
+            bench_obsplane(hosts=2, events_per_host=800, repeats=2)
     else:
         if want("fixed"):
             bench_fixed(jnp, jnp.bfloat16, b=16, h=576, w=768, steps=20)
@@ -1680,6 +1804,11 @@ def main() -> None:
             # streaming-session tier: single engine, capacity-probed 2x
             # overload, sessions + legacy arms (BENCH_STREAM_cpu_r15.json)
             bench_stream()
+        if want("obsplane"):
+            # fleet-observability tier: pure host-side, 4 simulated
+            # pushers through the real ingest path
+            # (BENCH_OBSPLANE_cpu_r16.json)
+            bench_obsplane()
 
     if _TELEMETRY is not None:
         from can_tpu.obs import emit_memory

@@ -126,6 +126,11 @@ def parse_args(argv=None):
                         "watching too)")
     p.add_argument("--metrics-host", type=str, default="127.0.0.1",
                    help="bind address for --metrics-port")
+    p.add_argument("--collector-push", type=str, default="",
+                   metavar="URL",
+                   help="stream telemetry to a FleetCollector "
+                        "(can_tpu.cli.collect) at URL — best-effort "
+                        "batched JSONL over HTTP (see the train CLI)")
     p.add_argument("--incident-dir", type=str, default="",
                    help="arm the incident layer: flight-recorder ring + "
                         "trigger-dumped bundles + SIGTERM/preemption "

@@ -168,6 +168,14 @@ def parse_args(argv=None):
     p.add_argument("--metrics-host", type=str, default="127.0.0.1",
                    help="bind address for --metrics-port (0.0.0.0 to let "
                         "a fleet scraper reach every host)")
+    p.add_argument("--collector-push", type=str, default="",
+                   metavar="URL",
+                   help="stream this host's telemetry to a FleetCollector "
+                        "(can_tpu.cli.collect) at URL as batched JSONL "
+                        "over HTTP POST /ingest — live fleet-level "
+                        "gauges, global SLO burn, clock-skew-corrected "
+                        "liveness.  Best-effort: a dead collector costs "
+                        "dropped batches (counted), never the run")
     p.add_argument("--incident-dir", type=str, default="",
                    help="arm the incident layer (obs/incidents.py): a "
                         "flight-recorder ring retains the last N events "
@@ -359,12 +367,26 @@ def build_telemetry(args, *, host_id: int, trace_window, logger=None,
     burn-rate engine.  Returns
     ``(telemetry, heartbeat_or_None, exporter_or_None)`` — tear the
     stack down with ``obs.shutdown_telemetry`` (one deterministic order
-    for clean exit and SIGTERM alike)."""
+    for clean exit and SIGTERM alike).
+
+    ``--collector-push URL`` adds a best-effort push sink streaming the
+    bus to a FleetCollector; ``CAN_TPU_HOST_ID`` overrides the host id
+    on every emitted event (several processes on one machine all read
+    ``process_index() == 0`` — the fleet view needs them distinct)."""
     from can_tpu import obs
 
+    env_hid = os.environ.get("CAN_TPU_HOST_ID", "")
+    if env_hid:
+        try:
+            host_id = int(env_hid)
+        except ValueError:
+            raise SystemExit(f"CAN_TPU_HOST_ID: not an int: {env_hid!r}")
     trace = (obs.StepTraceWindow(args.profile_dir, *trace_window)
              if trace_window else None)
     extra = [obs.MetricLoggerSink(logger)] if logger is not None else []
+    collector_url = getattr(args, "collector_push", "")
+    if collector_url:
+        extra.append(obs.CollectorPushSink(collector_url))
     exporter = None
     gauges = None
     metrics_port = getattr(args, "metrics_port", None)

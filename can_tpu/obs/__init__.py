@@ -27,8 +27,9 @@ from .bus import (
     Telemetry,
     open_host_telemetry,
 )
+from .collector import COLLECTOR_HOST_ID, CollectorPushSink, FleetCollector
 from .costs import ProgramCostLedger
-from .exporter import GaugeSink, MetricsExporter, render_stats
+from .exporter import GaugeSink, MetricsExporter, aggregate_fleet, render_stats
 from .flightrec import FlightRecorder
 from .health import (
     EwmaMadDetector,
@@ -51,7 +52,10 @@ from .spans import SpanTracer
 from .trace import StepTraceWindow, parse_trace_steps
 
 __all__ = [
+    "COLLECTOR_HOST_ID",
+    "CollectorPushSink",
     "EVENT_KINDS",
+    "FleetCollector",
     "EwmaMadDetector",
     "FlightRecorder",
     "GaugeSink",
@@ -75,6 +79,7 @@ __all__ = [
     "ThroughputDetector",
     "device_memory_snapshot",
     "emit_memory",
+    "aggregate_fleet",
     "format_report",
     "grade_events",
     "install_sigterm_handler",

@@ -88,12 +88,21 @@ import numpy as np
 # one sticky-pin invalidation after a fleet fault (quarantine / wedge /
 # scale-down / resurrection at a new incarnation) with the live replica
 # the stream re-pinned to.
+# fleet.host and collector.ingest come from the fleet observability
+# plane (obs/collector.py): fleet.host is a HOST-level liveness
+# transition on the collector's skew-corrected clock (stale when
+# heartbeats age past the bound — "no data ≠ healthy" — or back to live
+# on recovery; carries the live/stale host counts and triggers an
+# incident bundle), and collector.ingest is one accepted ingest batch
+# for one host (tail or push transport, event + torn-line counts —
+# can_tpu_collector_events_total{host}).
 EVENT_KINDS = ("compile", "step_window", "stall", "memory", "heartbeat",
                "epoch", "bench", "run",
                "serve.request", "serve.batch", "serve.reject",
                "serve.warmup",
                "fleet.replica", "fleet.rollout",
                "fleet.probe", "fleet.resurrect", "fleet.scale",
+               "fleet.host", "collector.ingest",
                "stream.session", "stream.degrade", "stream.repin",
                "data.prepared", "data.cache", "data.planner",
                "health.alert", "health.summary",

@@ -285,6 +285,32 @@ class GaugeSink:
                 if p.get("alerting"):
                     self._count((f"{pre}_slo_alerts_total",
                                  (("objective", name),)))
+            elif kind == "fleet.host":
+                # a HOST-level liveness transition (obs/collector.py):
+                # stale = heartbeats older than the bound on the
+                # skew-corrected clock — "no data ≠ healthy".  The live
+                # counts ride the event (sampled exactly when the set
+                # changes, the serve.batch queue-depth discipline)
+                self._count((f"{pre}_fleet_host_transitions_total",
+                             (("state", str(p.get("state", "?"))),)))
+                if p.get("live") is not None:
+                    self._gauges[f"{pre}_fleet_hosts_live"] = \
+                        float(p["live"])
+                if p.get("stale") is not None:
+                    self._gauges[f"{pre}_fleet_hosts_stale"] = \
+                        float(p["stale"])
+            elif kind == "collector.ingest":
+                # one collector ingest batch accepted for one host:
+                # events/torn-line counts by host label, transport
+                # (tail|push) recorded as its own counter dimension
+                host = str(p.get("host", "?"))
+                self._count((f"{pre}_collector_events_total",
+                             (("host", host),)),
+                            float(p.get("events", 0)))
+                if p.get("torn"):
+                    self._count((f"{pre}_collector_torn_total",
+                                 (("host", host),)),
+                                float(p["torn"]))
             elif kind == "incident.bundle":
                 self._count((f"{pre}_incidents_total",
                              (("reason", str(p.get("reason", "?"))),)))
@@ -334,6 +360,83 @@ class GaugeSink:
                     {"name": n, "labels": dict(labels), "value": v}
                     for (n, labels), v in sorted(self._counters.items())],
             }
+
+
+# how a fleet rollup folds one gauge across hosts (obs/collector.py's
+# federated /metrics): "sum" for capacity-like gauges where the fleet
+# value is the total, "last" for stream-position gauges where the most
+# recently heartbeating host is the truth, "max" (the default) for
+# watermarks and progress.  Counters always sum — they are totals by
+# construction.
+DEFAULT_FLEET_AGG: Dict[str, str] = {
+    "can_tpu_stream_sessions": "sum",
+    "can_tpu_fleet_live_replicas": "sum",
+    "can_tpu_host_rss_mb": "sum",
+    "can_tpu_loss": "last",
+    "can_tpu_step_time_p50_s": "last",
+}
+
+
+def aggregate_fleet(snapshots: Dict[int, dict], *, label: str = "host",
+                    agg: Optional[Dict[str, str]] = None
+                    ) -> Tuple[Dict[str, float],
+                               Dict[Tuple[str, tuple], float],
+                               Dict[Tuple[str, tuple], float]]:
+    """Fold per-host ``GaugeSink.snapshot()`` dicts into one federated
+    exposition: every per-host sample re-emitted with a ``host`` label,
+    PLUS one plain fleet rollup per gauge/counter family.  Returns
+    ``(gauges, counters, labelled_gauges)`` shaped for
+    :func:`render_prometheus` — which renders a family's plain rollup
+    and its host-labelled members under ONE ``# TYPE`` line (the PR-8
+    dup-TYPE rule, now extended to host-labelled families).
+
+    Rollups: counters sum; gauges follow ``agg`` (name -> sum|max|last,
+    over :data:`DEFAULT_FLEET_AGG`, default max), where "last" takes the
+    value from the host with the newest heartbeat.  Per-host LABELLED
+    gauges (per-objective burns etc.) are host-labelled but not rolled
+    up — cross-host aggregates of those need real cross-host arithmetic
+    (the collector's global SLO engine), not a per-name fold."""
+    rules = dict(DEFAULT_FLEET_AGG)
+    rules.update(agg or {})
+    gauges: Dict[str, float] = {}
+    counters: Dict[Tuple[str, tuple], float] = {}
+    labelled: Dict[Tuple[str, tuple], float] = {}
+    # hosts ordered oldest-heartbeat first, so for "last" the newest
+    # heartbeat's value lands last and wins the fold
+    def _hb(item):
+        hid, snap = item
+        hb = (snap.get("gauges") or {}).get("can_tpu_last_heartbeat_ts")
+        return (hb if isinstance(hb, (int, float)) else float("-inf"),
+                hid)
+    ordered = sorted(snapshots.items(), key=_hb)
+    for hid, snap in ordered:
+        hl = (label, str(hid))
+        for name, v in sorted((snap.get("gauges") or {}).items()):
+            if v is None:
+                continue
+            labelled[(name, (hl,))] = v
+            rule = rules.get(name, "max")
+            if rule == "sum":
+                gauges[name] = gauges.get(name, 0.0) + float(v)
+            elif rule == "last":
+                gauges[name] = v
+            else:
+                gauges[name] = (v if name not in gauges
+                                else max(gauges[name], v))
+        for row in snap.get("labelled_gauges") or ():
+            labels = tuple(sorted(dict(row.get("labels") or {},
+                                       **{label: str(hid)}).items()))
+            labelled[(row["name"], labels)] = row["value"]
+        for row in snap.get("counters") or ():
+            base = dict(row.get("labels") or {})
+            base.pop(label, None)
+            labels = tuple(sorted({**base, label: str(hid)}.items()))
+            counters[(row["name"], labels)] = \
+                counters.get((row["name"], labels), 0.0) + row["value"]
+            roll = tuple(sorted(base.items()))
+            counters[(row["name"], roll)] = \
+                counters.get((row["name"], roll), 0.0) + row["value"]
+    return gauges, counters, labelled
 
 
 def render_stats(stats: dict, *, prefix: str = "can_tpu_serve",

@@ -183,6 +183,11 @@ class IncidentManager:
             self.trigger(f"health_{p.get('alert')}", detail=p)
         elif kind == "fleet.replica" and p.get("state") == "quarantined":
             self.trigger("fleet_quarantine", detail=p)
+        elif kind == "fleet.host" and p.get("state") == "stale":
+            # a silent HOST (obs/collector.py liveness rule): the moment
+            # "no data ≠ healthy" fires is exactly when its recent
+            # telemetry is worth freezing
+            self.trigger("fleet_host_stale", detail=p)
         elif kind == "slo.burn" and p.get("alerting"):
             self.trigger(f"slo_{p.get('objective', '?')}", detail=p,
                          severity="warning")

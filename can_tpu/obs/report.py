@@ -96,6 +96,10 @@ def summarize(events: Iterable[dict]) -> dict:
     fleet_resurrections = 0
     fleet_probes = {"ok": 0, "failed": 0}
     fleet_ttfr_last = None
+    fleet_host_states: dict = {}
+    fleet_host_stale_events = 0
+    collector_ingested = 0
+    collector_torn = 0
     cache_last: Optional[dict] = None
     planner_last: Optional[dict] = None
     prepared_splits: dict = {}
@@ -212,6 +216,14 @@ def summarize(events: Iterable[dict]) -> dict:
                 fleet_live_last = int(p["live"])
         elif kind == "fleet.probe":
             fleet_probes["ok" if p.get("ok") else "failed"] += 1
+        elif kind == "fleet.host":
+            hk = str(p.get("host", "?"))
+            fleet_host_states[hk] = str(p.get("state", "?"))  # last wins
+            if p.get("state") == "stale":
+                fleet_host_stale_events += 1
+        elif kind == "collector.ingest":
+            collector_ingested += int(p.get("events", 0))
+            collector_torn += int(p.get("torn", 0))
         elif kind == "stream.session":
             if p.get("active") is not None:
                 stream_sessions_last = int(p["active"])
@@ -303,6 +315,12 @@ def summarize(events: Iterable[dict]) -> dict:
         "fleet_probes_ok": fleet_probes["ok"],
         "fleet_probes_failed": fleet_probes["failed"],
         "fleet_ttfr_last_s": fleet_ttfr_last,
+        # fleet observability plane (obs/collector.py): per-HOST
+        # liveness transitions + ingest totals; empty/zero off-collector
+        "fleet_host_states": dict(sorted(fleet_host_states.items())),
+        "fleet_host_stale_events": fleet_host_stale_events,
+        "collector_ingested": collector_ingested,
+        "collector_torn": collector_torn,
         # host data pipeline (can_tpu/data/prepared.py); Nones/empty offline
         "prepared_splits": dict(sorted(prepared_splits.items())),
         "cache_hits": cache_last.get("hits") if cache_last else None,
@@ -572,6 +590,18 @@ def format_report(summary: dict, *, title: str = "telemetry") -> str:
                 if summary.get("fleet_live_replicas") is not None else "")
              + (f" ttfr={_fmt(summary['fleet_ttfr_last_s'])} s"
                 if summary.get("fleet_ttfr_last_s") is not None else "")))
+    if (summary.get("fleet_host_states")
+            or summary.get("collector_ingested")
+            or summary.get("collector_torn")):
+        hosts = summary.get("fleet_host_states") or {}
+        rows.append(
+            ("fleet hosts",
+             f"ingested={summary.get('collector_ingested', 0)} "
+             f"torn={summary.get('collector_torn', 0)} "
+             f"stale events={summary.get('fleet_host_stale_events', 0)}"
+             + ((" hosts: "
+                 + " ".join(f"h{k}={v}" for k, v in hosts.items()))
+                if hosts else "")))
     width = max(len(k) for k, _ in rows)
     lines = [f"# {title}"]
     lines += [f"{k.ljust(width)}  {v}" for k, v in rows]

@@ -357,6 +357,47 @@ class SloEngine:
             self.evaluate(last)
 
 
+def replay_evals(events: Sequence[dict], spec: SloSpec,
+                 engine: Optional[SloEngine] = None
+                 ) -> Tuple[SloEngine, List[Tuple[float, dict]]]:
+    """The offline feed loop, factored so the fleet collector's
+    bit-identity oracle IS this code: sort by ``ts`` (Python's stable
+    sort — equal timestamps keep input order, which for concatenated
+    per-host files means (host, line) order), feed every ts-carrying
+    event, tail-evaluate at the final event time unless that event
+    itself just evaluated.  Returns the engine and every
+    ``(eval_ts, payload)`` pair."""
+    if engine is None:
+        engine = SloEngine(spec, telemetry=None)
+    ordered = sorted((e for e in events
+                      if isinstance(e.get("ts"), (int, float))),
+                     key=lambda e: e["ts"])
+    evals: List[Tuple[float, dict]] = []
+    for e in ordered:
+        out = engine.on_event(e)
+        if out:
+            evals.extend((e["ts"], p) for p in out)
+    if ordered:
+        # tail evaluation at the final event time — unless the final
+        # event itself just evaluated (double-counting its alerts)
+        last_ts = float(ordered[-1]["ts"])
+        evals.extend((last_ts, p)
+                     for p in tail_evaluate(engine, last_ts))
+    engine._replay_events = len(ordered)
+    return engine, evals
+
+
+def tail_evaluate(engine: SloEngine, last_ts: float) -> List[dict]:
+    """Final evaluation at ``last_ts`` — a no-op when the last event
+    already evaluated there (the exact rule :func:`replay_evals` uses;
+    the live collector's drain calls this so its closing evaluation is
+    bit-identical to the offline tail)."""
+    with engine._lock:
+        already = (engine._last_eval is not None
+                   and engine._last_eval >= last_ts)
+    return [] if already else engine.evaluate(last_ts)
+
+
 def grade_events(events: Sequence[dict], spec: SloSpec) -> dict:
     """Offline replay: feed a finished run's events (any order; sorted
     here by ``ts``) through the SAME engine arithmetic, collect every
@@ -372,28 +413,20 @@ def grade_events(events: Sequence[dict], spec: SloSpec) -> dict:
     Returns ``{"objectives": {...}, "violations": [...],
     "evaluations": n, "events": n}`` — ``tools/slo_report.py`` renders
     it and exits 1 on any violation."""
-    engine = SloEngine(spec, telemetry=None)
-    ordered = sorted((e for e in events
-                      if isinstance(e.get("ts"), (int, float))),
-                     key=lambda e: e["ts"])
-    evals: List[Tuple[float, dict]] = []
-    for e in ordered:
-        out = engine.on_event(e)
-        if out:
-            evals.extend((e["ts"], p) for p in out)
-    if ordered:
-        # tail evaluation at the final event time — unless the final
-        # event itself just evaluated (double-counting its alerts)
-        last_ts = float(ordered[-1]["ts"])
-        with engine._lock:
-            already = (engine._last_eval is not None
-                       and engine._last_eval >= last_ts)
-        if not already:
-            for p in engine.evaluate(last_ts):
-                evals.append((last_ts, p))
+    engine, evals = replay_evals(events, spec)
+    return aggregate_grade(spec, evals, engine.run_totals(),
+                           n_events=engine._replay_events)
+
+
+def aggregate_grade(spec: SloSpec, evals: Sequence[Tuple[float, dict]],
+                    totals: Dict[str, Tuple[int, int]], *,
+                    n_events: int) -> dict:
+    """Fold evaluation payloads + run totals into the grade dict —
+    shared verbatim by :func:`grade_events` (offline) and the fleet
+    collector's live verdict (``obs/collector.py``), so "live == replay"
+    is a property of the inputs, never of two graders drifting."""
     objectives: dict = {}
     violations: List[dict] = []
-    totals = engine.run_totals()
     for obj in spec.objectives:
         good, bad = totals.get(obj.name, (0, 0))
         n = good + bad
@@ -445,4 +478,4 @@ def grade_events(events: Sequence[dict], spec: SloSpec) -> dict:
                            f"(target {obj.target})"),
             })
     return {"objectives": objectives, "violations": violations,
-            "evaluations": len(evals), "events": len(ordered)}
+            "evaluations": len(evals), "events": n_events}

@@ -303,7 +303,8 @@ class CountService:
                deadline_ms: Optional[float] = None,
                want_density: bool = False,
                stream_id: Optional[str] = None,
-               frame_seq: Optional[int] = None) -> ServeTicket:
+               frame_seq: Optional[int] = None,
+               trace_id: Optional[str] = None) -> ServeTicket:
         """Enqueue one prepared image (see ``prepare_image``).  Returns a
         ticket whose ``result()`` either yields a ``ServeResult`` or raises
         ``RejectedError`` — immediate rejection (full queue, shedding,
@@ -329,8 +330,13 @@ class CountService:
                            stream_id=stream_id, frame_seq=frame_seq)
         # the trace is born at the front door: every span of this
         # request's life (queue wait -> assembly -> device -> respond)
-        # keys on this id, and HTTP clients get it back in the response
-        req.trace_id = f"{self._trace_prefix}-{req.id}"
+        # keys on this id, and HTTP clients get it back in the response.
+        # A caller-provided id (the X-CanTpu-Trace-Id request header, or
+        # an upstream service propagating its own) wins over minting —
+        # that is what stitches one trace ACROSS hosts: every hop's
+        # spans key on the same id, and the fleet collector's snapshot
+        # exports them as one skew-corrected timeline
+        req.trace_id = trace_id or f"{self._trace_prefix}-{req.id}"
         if req.shape[0] % self.engine.ds or req.shape[1] % self.engine.ds:
             raise ValueError(
                 f"image shape {req.shape} is not snapped to the /"
@@ -441,11 +447,13 @@ class CountService:
                 want_density: bool = False,
                 timeout: Optional[float] = None,
                 stream_id: Optional[str] = None,
-                frame_seq: Optional[int] = None) -> ServeResult:
+                frame_seq: Optional[int] = None,
+                trace_id: Optional[str] = None) -> ServeResult:
         """submit + result in one call (the closed-loop client pattern)."""
         return self.submit(image, deadline_ms=deadline_ms,
                            want_density=want_density, stream_id=stream_id,
-                           frame_seq=frame_seq).result(timeout)
+                           frame_seq=frame_seq,
+                           trace_id=trace_id).result(timeout)
 
     def stats(self) -> dict:
         with self._lock:
@@ -761,11 +769,14 @@ def make_http_handler(service: CountService):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(self, code: int, payload: dict,
+                  headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -868,6 +879,12 @@ def make_http_handler(service: CountService):
                 stream_id = q.get("stream_id", [None])[0] or None
                 frame_seq = (int(q["frame_seq"][0])
                              if "frame_seq" in q else None)
+                # cross-host trace propagation: an upstream hop's id
+                # rides in on this header, keys every span this host
+                # emits, and is echoed back on the response — one
+                # trace_id, one stitched timeline (tools/trace_export.py
+                # over a collector snapshot)
+                trace_in = self.headers.get("X-CanTpu-Trace-Id") or None
                 if frame_seq is not None and stream_id is None:
                     raise ValueError("frame_seq needs a stream_id")
                 if raw and arr.dtype != np.uint8:
@@ -888,7 +905,8 @@ def make_http_handler(service: CountService):
                 res = service.predict(image, deadline_ms=deadline_ms,
                                       want_density=want_density,
                                       stream_id=stream_id,
-                                      frame_seq=frame_seq)
+                                      frame_seq=frame_seq,
+                                      trace_id=trace_in)
             except ValueError as e:  # submit-side validation: client error
                 self._send(400, {"error": f"bad request: {e}"})
                 return
@@ -915,7 +933,9 @@ def make_http_handler(service: CountService):
                     payload["staleness_s"] = round(res.staleness_s, 6)
             if res.density is not None:
                 payload["density"] = res.density[..., 0].tolist()
-            self._send(200, payload)
+            self._send(200, payload,
+                       headers=({"X-CanTpu-Trace-Id": res.trace_id}
+                                if res.trace_id is not None else None))
 
     return Handler
 

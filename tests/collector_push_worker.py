@@ -1,0 +1,48 @@
+"""Subprocess worker for the 2-process collector push e2e
+(tests/test_collector.py): one emitting host shipping its telemetry bus
+over HTTP to a FleetCollector — the no-shared-filesystem transport.
+
+    python tests/collector_push_worker.py <collector_url> <host_id> <n>
+
+Emits three immediate heartbeats (the collector freezes its clock-skew
+estimate at the third), then ``n`` serve.request events — every 10th
+breaching the test spec's 1 s latency threshold — interleaved with more
+heartbeats, and one final heartbeat so the collector's watermark can
+release the tail.  Prints a DONE line with the sink's delivery counters.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from can_tpu.obs.bus import Telemetry  # noqa: E402
+from can_tpu.obs.collector import CollectorPushSink  # noqa: E402
+
+
+def main(argv) -> int:
+    url, host_id, n = argv[0], int(argv[1]), int(argv[2])
+    sink = CollectorPushSink(url, flush_interval_s=0.05)
+    tel = Telemetry([sink], host_id=host_id)
+    start = time.time()
+    for seq in range(3):
+        tel.emit("heartbeat", seq=seq, start_ts=start, uptime_s=0.0)
+    for i in range(n):
+        tel.emit("serve.request", request_id=i, ok=True,
+                 latency_s=(3.0 if i % 10 == 0 else 0.02))
+        if i % 10 == 9:
+            tel.emit("heartbeat", seq=3 + i // 10, start_ts=start,
+                     uptime_s=time.time() - start)
+        time.sleep(0.05)
+    tel.emit("heartbeat", seq=1000, start_ts=start,
+             uptime_s=time.time() - start)
+    tel.close()  # joins the flusher after a final flush
+    print(f"DONE host={host_id} pushed={sink.pushed_events} "
+          f"dropped={sink.dropped} failures={sink.push_failures}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

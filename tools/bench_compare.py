@@ -66,7 +66,10 @@ def _direction(unit: str) -> int:
         return +1
     if "/sec" in u or "/s" in u:
         return +1
-    if u in ("seconds", "s", "ms", "gflops", "gbytes"):
+    if u in ("seconds", "s", "ms", "gflops", "gbytes", "mb"):
+        # mb: the obsplane tier's collector steady-state RSS — memory
+        # creeping UP under the same ingest load means the bounded-ring
+        # discipline sprang a leak
         return -1
     return 0
 

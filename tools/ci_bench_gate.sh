@@ -50,6 +50,15 @@
 #       (no-session) arm's reject fraction ride the artifact ungated as
 #       the ladder-engagement receipt.  Single device, no cpu8 needed.
 #
+#   CI_BENCH_ONLY=obsplane tools/ci_bench_gate.sh BENCH_OBSPLANE_cpu_r16.json
+#       gates the fleet-observability-plane tier (obs/collector.py):
+#       collector ingest throughput through the real push path (unit
+#       events/s, gated on decrease), steady-state RSS at 4 simulated
+#       hosts (unit mb, gated UPWARD — memory growing under the same
+#       load means the bounded-ring discipline leaked), and the
+#       /metrics render cost (ms, upward).  Pure host-side: no
+#       accelerator, no cpu8.
+#
 #   CI_BENCH_ONLY=slo tools/ci_bench_gate.sh
 #       gates the SLO layer: tools/slo_report.py grades the committed
 #       telemetry fixture (SLO_FIXTURE_cpu_r15.jsonl: the r12
@@ -148,6 +157,10 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
     # BENCH_STREAM_OUT: sixth instance — the stream tier's artifact
     # defaults to the committed BENCH_STREAM_cpu_r15.json exactly when
     # BENCH_SUITE_ONLY=stream, which is how this gate runs it.
+    # BENCH_OBSPLANE_OUT: seventh instance — the obsplane tier's
+    # artifact defaults to the committed BENCH_OBSPLANE_cpu_r16.json
+    # exactly when BENCH_SUITE_ONLY=obsplane, which is how this gate
+    # runs it.
     BENCH_SUITE_ONLY="$ONLY" JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
         BENCH_PERF_LEDGER_OUT="${BENCH_PERF_LEDGER_OUT:-${OUT}.ledger.json}" \
         BENCH_BN_OUT="${BENCH_BN_OUT:-${OUT}.bn.json}" \
@@ -155,6 +168,7 @@ if [ -z "${CI_BENCH_SKIP_RUN:-}" ]; then
         BENCH_AUTOSCALE_OUT="${BENCH_AUTOSCALE_OUT:-${OUT}.autoscale.json}" \
         BENCH_SCHED_OUT="${BENCH_SCHED_OUT:-${OUT}.sched.json}" \
         BENCH_STREAM_OUT="${BENCH_STREAM_OUT:-${OUT}.stream.json}" \
+        BENCH_OBSPLANE_OUT="${BENCH_OBSPLANE_OUT:-${OUT}.obsplane.json}" \
         python bench_suite.py > "$RAW"
     grep '^{' "$RAW" > "$OUT"
 fi

@@ -17,8 +17,15 @@ Detection:
 * straggler — a host whose recent median step time exceeds the fleet's
   fastest host by ``--skew-factor`` (default 1.5x).  Lockstep training
   runs at the SLOWEST host's pace, so one straggler taxes every chip.
-* dead host — last heartbeat older than ``--stale-after-s`` relative to
-  the fleet's newest event (post-hoc) or the wall clock (``--follow``).
+* dead host — last heartbeat older than ``--stale-after-s`` on the
+  fleet's CORRECTED clock: per-host clock offsets (obs/join.py — a
+  collector snapshot's measured offsets when present, else the
+  first-heartbeat-vs-fleet-median estimate) are subtracted before the
+  staleness judgement, in BOTH modes (post-hoc anchors at the newest
+  corrected event; ``--follow`` at the wall clock).  Without this a
+  host whose clock runs fast inflates its raw timestamps, reads
+  forever-fresh, and drags "now" forward so the honest hosts look
+  stale instead — the exact asymmetry the correction closes.
   Restarted processes are distinguished from resumed streams by the
   heartbeat payload's ``start_ts``/``seq`` (obs/sources.py).
 * alerts — ``health.alert`` rollup per host, by ``signal/alert`` kind.
@@ -42,7 +49,6 @@ import argparse
 import glob
 import json
 import os
-import re
 import statistics
 import sys
 import time
@@ -50,10 +56,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from can_tpu.obs.incidents import MANIFEST_NAME, read_manifest  # noqa: E402
-from can_tpu.obs.report import read_events_counted  # noqa: E402
+from can_tpu.obs.join import (  # noqa: E402
+    DEFAULT_SNAP_S,
+    HostTail,
+    collector_offsets,
+    corrected_staleness,
+    corrected_ts,
+    discover_host_files,
+    estimate_offsets,
+    is_collector_snapshot,
+    load_collector_manifest,
+    read_host_events,
+)
 from can_tpu.obs.signals import write_signal  # noqa: E402
 
-_HOST_RE = re.compile(r"telemetry\.host(\d+)\.jsonl$")
+__all__ = ["HostTail", "analyze_dir", "analyze_host", "analyze_run",
+           "discover_hosts", "follow_dir", "main"]
 
 # where bundles live relative to a run dir: beside the telemetry files,
 # under the conventional incidents/ subdir, or one directory down (a
@@ -109,13 +127,10 @@ def correlate_incidents(incidents: list, *,
 
 
 def discover_hosts(run_dir: str) -> dict:
-    """``host_id -> path`` for every per-host file in the run dir."""
-    hosts = {}
-    for path in glob.glob(os.path.join(run_dir, "telemetry.host*.jsonl")):
-        m = _HOST_RE.search(path)
-        if m:
-            hosts[int(m.group(1))] = path
-    return dict(sorted(hosts.items()))
+    """``host_id -> path`` for every per-host file in the run dir
+    (thin alias of the shared ``obs/join.py`` discovery, kept for the
+    tool's public surface)."""
+    return discover_host_files(run_dir)
 
 
 def analyze_host(events, *, skipped: int = 0,
@@ -127,6 +142,7 @@ def analyze_host(events, *, skipped: int = 0,
     not the whole-run average a long warmup would bias."""
     last_ts = None
     last_hb_ts = None
+    first_hb_ts = None
     hb_seq = None
     starts = []
     steps = 0
@@ -142,6 +158,8 @@ def analyze_host(events, *, skipped: int = 0,
         p = e.get("payload", {})
         if kind == "heartbeat":
             if isinstance(ts, (int, float)):
+                if first_hb_ts is None:  # the offline skew anchor
+                    first_hb_ts = ts
                 last_hb_ts = (ts if last_hb_ts is None
                               else max(last_hb_ts, ts))
             if "seq" in p:
@@ -166,6 +184,7 @@ def analyze_host(events, *, skipped: int = 0,
         "skipped_lines": skipped,
         "last_ts": last_ts,
         "last_heartbeat_ts": last_hb_ts,
+        "first_heartbeat_ts": first_hb_ts,
         "heartbeat_seq": hb_seq,
         "restarts": max(0, len(starts) - 1),
         "steps": steps,
@@ -178,15 +197,31 @@ def analyze_host(events, *, skipped: int = 0,
 
 
 def analyze_run(host_stats: dict, *, now=None, stale_after_s: float = 180.0,
-                skew_factor: float = 1.5) -> dict:
+                skew_factor: float = 1.5, offsets=None,
+                snap_s: float = DEFAULT_SNAP_S) -> dict:
     """Fleet verdict over per-host vitals (``analyze_host`` outputs).
 
-    ``now=None`` (post-hoc) anchors staleness at the fleet's NEWEST event:
-    a finished healthy run — where every host stopped together — reads
-    healthy, while a host that died mid-run lags the survivors' tail.
-    Live callers pass ``time.time()``."""
+    ``now=None`` (post-hoc) anchors staleness at the fleet's NEWEST
+    CORRECTED event: a finished healthy run — where every host stopped
+    together — reads healthy, while a host that died mid-run lags the
+    survivors' tail.  Live callers pass ``time.time()``.
+
+    ``offsets`` is the per-host clock-offset map (``obs/join.py``
+    convention: positive ⇒ that host's clock runs fast).  ``None``
+    estimates from each host's first heartbeat against the fleet median
+    — so BOTH modes route staleness through the same corrected-clock
+    rule the live collector uses, and a fast clock can neither keep its
+    own dead host looking fresh nor drag "now" forward to falsely
+    condemn honest peers.  Nonzero offsets surface per host as
+    ``clock_skew_s``."""
+    if offsets is None:
+        offsets = estimate_offsets(
+            {hid: h.get("first_heartbeat_ts")
+             for hid, h in host_stats.items()}, snap_s=snap_s)
     if now is None:
-        now = max((h["last_ts"] for h in host_stats.values()
+        now = max((corrected_ts(h["last_ts"],
+                                float(offsets.get(hid, 0.0)))
+                   for hid, h in host_stats.items()
                    if h["last_ts"] is not None), default=0.0)
     stragglers = []
     dead = []
@@ -198,10 +233,14 @@ def analyze_run(host_stats: dict, *, now=None, stale_after_s: float = 180.0,
                 and paces[hid] > skew_factor * fastest:
             stragglers.append(hid)
             h["straggler_skew"] = round(paces[hid] / fastest, 3)
+        off = float(offsets.get(hid, 0.0))
+        if off:
+            h["clock_skew_s"] = off
         ref = (h["last_heartbeat_ts"] if h["last_heartbeat_ts"] is not None
                else h["last_ts"])
-        if ref is not None:
-            h["staleness_s"] = round(now - ref, 3)
+        stale = corrected_staleness(ref, off, now)
+        if stale is not None:
+            h["staleness_s"] = round(stale, 3)
             if h["staleness_s"] > stale_after_s:
                 dead.append(hid)
     alerts_total = sum(h["alerts_total"] for h in host_stats.values())
@@ -242,55 +281,33 @@ def analyze_dir(run_dir: str, *, now=None, stale_after_s: float = 180.0,
     hosts = discover_hosts(run_dir)
     if not hosts:
         raise SystemExit(f"no telemetry.host*.jsonl files in {run_dir}")
+    events_by_host, skipped_by_host = read_host_events(hosts)
     stats = {}
     for hid, path in hosts.items():
-        events, skipped = read_events_counted(path)
-        stats[hid] = analyze_host(events, skipped=skipped,
+        stats[hid] = analyze_host(events_by_host[hid],
+                                  skipped=skipped_by_host[hid],
                                   recent_windows=recent_windows)
         stats[hid]["path"] = path
     run = analyze_run(stats, now=now, stale_after_s=stale_after_s,
-                      skew_factor=skew_factor)
+                      skew_factor=skew_factor,
+                      offsets=_measured_offsets(run_dir, hosts))
     return attach_incidents(run, run_dir,
                             incident_window_s=incident_window_s)
 
 
-class HostTail:
-    """Incremental JSONL reader for --follow: remembers the byte offset
-    and keeps a partial trailing line in a buffer, so each poll costs
-    O(new bytes) instead of re-parsing a multi-day run's whole file.  A
-    line without its newline yet is a write IN PROGRESS, not a torn tail
-    — it stays buffered until complete (only a decode failure on a
-    COMPLETE line counts as skipped).  File truncation (rotation) resets
-    the tail."""
+# HostTail moved to can_tpu/obs/join.py (the live collector shares it);
+# re-exported above so `from tools.run_monitor import HostTail` keeps
+# working for existing babysitter scripts and tests.
 
-    def __init__(self, path: str):
-        self.path = path
-        self.offset = 0
-        self._buf = ""
-        self.events: list = []
-        self.skipped = 0
 
-    def poll(self) -> None:
-        try:
-            size = os.path.getsize(self.path)
-        except OSError:
-            return  # transiently unreadable; next poll retries
-        if size < self.offset:  # truncated/rotated underneath us
-            self.offset, self._buf = 0, ""
-            self.events, self.skipped = [], 0
-        with open(self.path) as f:
-            f.seek(self.offset)
-            chunk = f.read()
-            self.offset = f.tell()
-        *lines, self._buf = (self._buf + chunk).split("\n")
-        for line in lines:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                self.events.append(json.loads(line))
-            except json.JSONDecodeError:
-                self.skipped += 1
+def _measured_offsets(run_dir: str, hosts: dict):
+    """Measured clock offsets when ``run_dir`` is a collector snapshot
+    (they WIN over the first-heartbeat estimate — the collector saw
+    receive times), else ``None`` → ``analyze_run`` estimates."""
+    if not is_collector_snapshot(run_dir):
+        return None
+    measured = collector_offsets(load_collector_manifest(run_dir))
+    return {h: float(measured.get(h, 0.0)) for h in hosts}
 
 
 def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
@@ -315,7 +332,8 @@ def follow_dir(run_dir: str, tails: dict, *, stale_after_s: float,
                                   recent_windows=recent_windows)
         stats[hid]["path"] = path
     run = analyze_run(stats, now=time.time(),
-                      stale_after_s=stale_after_s, skew_factor=skew_factor)
+                      stale_after_s=stale_after_s, skew_factor=skew_factor,
+                      offsets=_measured_offsets(run_dir, hosts))
     return attach_incidents(run, run_dir,
                             incident_window_s=incident_window_s)
 

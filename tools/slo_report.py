@@ -3,9 +3,16 @@
 
 The live engine (``can_tpu/obs/slo.py``) watches the bus and pages on
 fast burn; this tool is the SAME arithmetic replayed offline over a
-telemetry artifact — a per-host JSONL, a ``--telemetry-dir``, or an
-incident bundle's ring dump — clocked by the events' own timestamps, so
-a violation here is exactly the alert the live run would have fired.
+telemetry artifact — a per-host JSONL, a ``--telemetry-dir``, an
+incident bundle's ring dump, or a FleetCollector snapshot — clocked by
+the events' own timestamps, so a violation here is exactly the alert
+the live run would have fired.  For a collector snapshot the manifest's
+MEASURED clock offsets are applied before the merge (obs/join.py), so
+this replay reproduces the live collector's global burn sequence
+bit-identically — the fleet observability plane's correctness oracle.
+Plain run dirs are graded on raw timestamps: post-hoc skew ESTIMATION
+is deliberately off here (a legitimately staggered start is not clock
+skew, and grading must never re-time events on a guess).
 
     python tools/slo_report.py runs/exp1/ --spec slo_spec.json
     python tools/slo_report.py runs/exp1/telemetry.host0.jsonl \
@@ -32,40 +39,25 @@ artifact was copied to (same contract as tools/telemetry_report.py).
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from can_tpu.obs.incidents import (  # noqa: E402
-    MANIFEST_NAME,
-    bundle_ring_path,
-    is_bundle_dir,
+from can_tpu.obs.join import (  # noqa: E402
+    load_joined_events,
+    resolve_telemetry_source,
 )
-from can_tpu.obs.report import read_events_counted  # noqa: E402
 from can_tpu.obs.slo import grade_events, load_slo_spec  # noqa: E402
 
 
 def resolve_paths(target: str) -> list:
-    """Telemetry file -> [it]; run dir -> its per-host files; incident
-    bundle dir (has incident.json) -> its ring dump."""
-    if os.path.isdir(target):
-        if is_bundle_dir(target):
-            try:
-                return [bundle_ring_path(target)]
-            except ValueError as e:
-                raise SystemExit(str(e))
-        paths = sorted(glob.glob(os.path.join(target,
-                                              "telemetry.host*.jsonl")))
-        if not paths:
-            raise SystemExit(f"no telemetry.host*.jsonl files (or "
-                             f"{MANIFEST_NAME}) in {target}")
-        return paths
-    if not os.path.isfile(target):
-        raise SystemExit(f"no such file or directory: {target}")
-    return [target]
+    """Telemetry file -> [it]; run dir / collector snapshot -> its
+    per-host files; incident bundle dir (has incident.json) -> its ring
+    dump.  Thin alias of the shared ``obs/join.py`` resolution, kept
+    for the tool's public surface."""
+    return resolve_telemetry_source(target)[0]
 
 
 def _fmt_burns(worst: dict) -> str:
@@ -115,18 +107,16 @@ def main(argv=None) -> int:
         print(f"slo_report: bad spec: {e}", file=sys.stderr)
         return 2
     try:
-        paths = resolve_paths(args.target)
+        # estimate=False: snapshot manifests' MEASURED offsets apply,
+        # but plain run dirs are never re-timed on a guess
+        events, _, _ = load_joined_events(args.target, estimate=False)
     except SystemExit as e:  # usage-class failure: exit 2, not 1
         print(f"slo_report: {e}", file=sys.stderr)
         return 2
-    events = []
-    for path in paths:
-        try:
-            evs, _ = read_events_counted(path)
-        except OSError as e:
-            print(f"slo_report: cannot read {path}: {e}", file=sys.stderr)
-            return 2
-        events.extend(evs)
+    except OSError as e:
+        print(f"slo_report: cannot read {args.target}: {e}",
+              file=sys.stderr)
+        return 2
     if not events:
         print(f"slo_report: no telemetry events in {args.target}",
               file=sys.stderr)

@@ -23,6 +23,15 @@ its own ``tid`` lane plus a ``thread_name`` metadata event, so one
 request/epoch reads as one horizontal track.  Span/parent ids ride in
 ``args`` for tooling that wants to rebuild the tree.
 
+Cross-host stitching: serve hops propagate one trace_id over HTTP
+(``X-CanTpu-Trace-Id`` — can_tpu/serve/service.py), so ``--trace-id``
+over a multi-host artifact renders one request's journey across hosts
+as one timeline.  The re-anchoring wall clocks are SKEW-CORRECTED first
+(obs/join.py): a FleetCollector snapshot's measured per-host offsets
+when the target is one, else the first-heartbeat estimate — without
+this, a host running 2 minutes fast would shove its segment of the
+request 2 minutes off every other host's.
+
 Pure host-side file reading — no JAX import, safe anywhere the artifact
 was copied to (same contract as tools/telemetry_report.py).
 """
@@ -30,7 +39,6 @@ was copied to (same contract as tools/telemetry_report.py).
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
@@ -38,24 +46,26 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from can_tpu.obs.incidents import (  # noqa: E402
-    MANIFEST_NAME,
-    bundle_ring_path,
-    is_bundle_dir,
+from can_tpu.obs.join import (  # noqa: E402
+    load_joined_events,
+    resolve_telemetry_source,
 )
-from can_tpu.obs.report import read_events_counted  # noqa: E402
 
 _SPAN_KEYS = ("trace_id", "span_id", "parent_id", "name",
               "start_s", "duration_s")
 
 
-def spans_to_trace_events(events, *, trace_id: Optional[str] = None) -> dict:
+def spans_to_trace_events(events, *, trace_id: Optional[str] = None,
+                          offsets: Optional[dict] = None) -> dict:
     """``trace.span`` events -> a Chrome trace-event document
     (``{"traceEvents": [...], "displayTimeUnit": "ms"}``).
 
     Lanes (``tid``) are assigned per trace_id in order of first
     appearance — deterministic for a given artifact.  ``trace_id``
-    filters to one request/epoch tree."""
+    filters to one request/epoch tree.  ``offsets`` (host_id -> seconds
+    fast, obs/join.py convention) skew-corrects the per-host wall
+    anchors for RAW event streams; events already corrected upstream
+    (``load_joined_events``) must not pass it again."""
     spans = [e for e in events if e.get("kind") == "trace.span"]
     if trace_id is not None:
         spans = [e for e in spans
@@ -69,14 +79,17 @@ def spans_to_trace_events(events, *, trace_id: Optional[str] = None) -> dict:
     # the bus wall-clock ``ts`` each event also carries (cross-host skew
     # is then bounded by emit latency, not clock-epoch differences).
     base: dict = {}       # host_id -> min start_s (that host's clock)
-    wall0: dict = {}      # host_id -> min bus ts (wall clock)
+    wall0: dict = {}      # host_id -> min bus ts (skew-corrected wall)
+    offsets = offsets or {}
     for e in spans:
         p = e.get("payload", {})
         if "start_s" not in p:
             continue
         h = int(e.get("host_id", 0))
         base[h] = min(base.get(h, float("inf")), float(p["start_s"]))
-        wall0[h] = min(wall0.get(h, float("inf")), float(e.get("ts", 0.0)))
+        wall0[h] = min(wall0.get(h, float("inf")),
+                       float(e.get("ts", 0.0))
+                       - float(offsets.get(h, 0.0)))
     global_wall0 = min(wall0.values(), default=0.0)
     for e in spans:
         p = e.get("payload", {})
@@ -106,24 +119,10 @@ def spans_to_trace_events(events, *, trace_id: Optional[str] = None) -> dict:
 
 
 def resolve_paths(target: str) -> list:
-    if os.path.isdir(target):
-        # an incident bundle (obs/incidents.py) IS a telemetry source:
-        # its ring dump uses the bus schema, so "replica quarantined" ->
-        # flame view is one command on one artifact
-        if is_bundle_dir(target):
-            try:
-                return [bundle_ring_path(target)]
-            except ValueError as e:
-                raise SystemExit(str(e))
-        paths = sorted(glob.glob(os.path.join(target,
-                                              "telemetry.host*.jsonl")))
-        if not paths:
-            raise SystemExit(f"no telemetry.host*.jsonl files (or an "
-                             f"{MANIFEST_NAME} bundle) in {target}")
-        return paths
-    if not os.path.isfile(target):
-        raise SystemExit(f"no such file or directory: {target}")
-    return [target]
+    """Telemetry file / run dir / collector snapshot / incident bundle
+    -> the JSONL files to read.  Thin alias of the shared
+    ``obs/join.py`` resolution, kept for the tool's public surface."""
+    return resolve_telemetry_source(target)[0]
 
 
 def main(argv=None) -> int:
@@ -137,10 +136,11 @@ def main(argv=None) -> int:
                    help="export only this trace's span tree (the id a "
                         "serve response returns)")
     args = p.parse_args(argv)
-    events = []
-    for path in resolve_paths(args.target):
-        evs, _ = read_events_counted(path)
-        events.extend(evs)
+    # estimate=True: a flame view exists to compare timing across hosts,
+    # so skew correction is always on (measured snapshot offsets win;
+    # plain run dirs get the first-heartbeat estimate).  The events come
+    # back already corrected — no offsets passed below.
+    events, _, _ = load_joined_events(args.target, estimate=True)
     doc = spans_to_trace_events(events, trace_id=args.trace_id)
     n = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
     if not n:
